@@ -1,0 +1,22 @@
+"""dptpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA/pjit implementation of the capabilities of the
+``Esthesia/distributed-pytorch`` reference suite (ImageNet-1k classification
+with torchvision-style CNNs at single-device, single-host multi-chip, and
+multi-host pod scale), redesigned TPU-first:
+
+* NCCL/Gloo process groups + DistributedDataParallel's bucketed gradient
+  all-reduce (reference imagenet_ddp.py:104-105,127) become SPMD
+  ``shard_map``/``pjit`` over a ``jax.sharding.Mesh`` with ``lax.pmean``
+  gradients compiled onto ICI/DCN collectives.
+* NVIDIA Apex mixed precision (imagenet_ddp_apex.py:169-172) becomes a
+  native bf16 compute policy — no loss scaling needed on TPU.
+* The CUDA-stream DataPrefetcher (imagenet_ddp_apex.py:304-351) becomes a
+  double-buffered host pipeline with async ``device_put`` and on-device
+  fused uint8→bf16 normalization.
+
+Subpackages: ``config``, ``models``, ``ops``, ``data``, ``parallel``,
+``train``, ``utils``, ``cli``, ``native``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+from dptpu.ops.loss import cross_entropy_loss
+from dptpu.ops.metrics import accuracy, topk_correct_fraction
+from dptpu.ops.schedules import (
+    step_decay_lr,
+    warmup_step_decay_lr,
+    scale_lr_linear,
+)
+
+__all__ = [
+    "cross_entropy_loss",
+    "accuracy",
+    "topk_correct_fraction",
+    "step_decay_lr",
+    "warmup_step_decay_lr",
+    "scale_lr_linear",
+]

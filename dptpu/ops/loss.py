@@ -1,0 +1,26 @@
+"""Classification loss.
+
+TPU-native replacement for ``nn.CrossEntropyLoss()`` (reference
+imagenet_ddp.py:131, default mean reduction): softmax cross-entropy with
+integer labels, computed in float32 regardless of the compute dtype so that
+the bf16 policy (the Apex-AMP replacement) never loses precision in the
+log-sum-exp — the same role Apex's fp32 loss kept in its O1/O2 modes.
+"""
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean softmax cross-entropy.
+
+    Args:
+      logits: ``[batch, num_classes]`` array (any float dtype; upcast to f32).
+      labels: ``[batch]`` integer class ids.
+
+    Returns:
+      Scalar f32 mean loss (``nn.CrossEntropyLoss`` default reduction).
+    """
+    logits = logits.astype(jnp.float32)
+    per_example = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return per_example.mean()

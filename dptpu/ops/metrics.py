@@ -1,0 +1,33 @@
+"""Top-k accuracy.
+
+TPU-native equivalent of the reference's ``accuracy(output, target, topk)``
+(imagenet_ddp.py:381-395): top-k predictions via ``lax.top_k`` (compiles to a
+single fused TPU sort/select instead of the reference's
+topk→transpose→eq→expand chain), returning percentages ``×100/batch`` with
+identical semantics. jit-friendly — no host sync; callers pull scalars out
+once per print interval, mirroring the Apex script's advice to avoid
+per-step device→host syncs (imagenet_ddp_apex.py:386-388).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_correct_fraction(logits, labels, topk=(1,)):
+    """Fraction of examples whose label is within the top-k predictions.
+
+    Returns a tuple of scalar f32 fractions in [0, 1], one per k.
+    """
+    maxk = max(topk)
+    _, pred = jax.lax.top_k(logits, maxk)  # [batch, maxk]
+    correct = pred == labels[:, None]  # [batch, maxk] bool
+    fractions = []
+    for k in topk:
+        fractions.append(correct[:, :k].any(axis=1).mean(dtype=jnp.float32))
+    return tuple(fractions)
+
+
+def accuracy(logits, labels, topk=(1,)):
+    """Percent accuracy over the k top predictions, reference semantics
+    (imagenet_ddp.py:381-395): returns one value per k, scaled ×100."""
+    return tuple(f * 100.0 for f in topk_correct_fraction(logits, labels, topk))

@@ -1,0 +1,42 @@
+"""Learning-rate schedules with the reference's exact math.
+
+Two schedules exist in the reference, reimplemented here as pure functions
+(the reference mutates ``optimizer.param_groups`` in place; here the value is
+fed into the jitted train step each step — schedules stay host-side Python,
+the update stays compiled):
+
+* Step decay — ``lr = lr0 * 0.1**(epoch // 30)``
+  (imagenet_ddp.py:374-378; nd_imagenet.py:428-432).
+* Apex variant — the step decay plus an EXTRA ×0.1 at epoch ≥ 80 and a
+  5-epoch linear warmup scaled by global step, applied per-step
+  (imagenet_ddp_apex.py:527-543), on top of the linear-scaling rule
+  ``lr0 · global_batch/256`` (imagenet_ddp_apex.py:161-162).
+"""
+
+
+def step_decay_lr(base_lr, epoch):
+    """``lr = base_lr * 0.1**(epoch // 30)`` (imagenet_ddp.py:376)."""
+    return base_lr * (0.1 ** (epoch // 30))
+
+
+def warmup_step_decay_lr(base_lr, epoch, step, len_epoch):
+    """Apex schedule (imagenet_ddp_apex.py:527-543).
+
+    ``step`` is 1-based within the epoch, exactly as the reference's train
+    loop increments ``i`` before the first use (imagenet_ddp_apex.py:367-369).
+    Docstring claim carried over: "should yield 76% converged accuracy with
+    batch size 256".
+    """
+    factor = epoch // 30
+    if epoch >= 80:
+        factor = factor + 1
+    lr = base_lr * (0.1 ** factor)
+    if epoch < 5:
+        lr = lr * float(1 + step + epoch * len_epoch) / (5.0 * len_epoch)
+    return lr
+
+
+def scale_lr_linear(base_lr, global_batch_size):
+    """Linear-scaling rule: ``lr0 · global_batch/256``
+    (imagenet_ddp_apex.py:161-162)."""
+    return base_lr * float(global_batch_size) / 256.0

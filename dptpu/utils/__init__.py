@@ -1,0 +1,3 @@
+from dptpu.utils.meters import AverageMeter, ProgressMeter, Summary
+
+__all__ = ["AverageMeter", "ProgressMeter", "Summary"]

@@ -1,0 +1,87 @@
+"""Running-statistics meters with the reference's exact display surface.
+
+The reference ships three meter variants with one shared core:
+
+* ``AverageMeter(name, fmt)`` with ``val/sum/count/avg`` running stats and a
+  ``"{name} {val:fmt} ({avg:fmt})"`` string form (imagenet_ddp.py:333-354).
+* The Apex variant drops ``name``/``fmt`` (imagenet_ddp_apex.py:509-524) —
+  covered here by the defaults.
+* The nd variant adds a ``Summary`` enum {NONE, AVERAGE, SUM, COUNT} and a
+  ``summary()`` formatter (nd_imagenet.py:361-404).
+
+``ProgressMeter`` prints ``"<prefix>[i/N]\\t<meter>\\t<meter>..."`` lines
+(imagenet_ddp.py:357-371) plus the nd variant's ``display_summary()``
+(nd_imagenet.py:418-421). This single implementation is a superset of all
+three, so every entry point shares one meter surface.
+"""
+
+from enum import Enum
+
+
+class Summary(Enum):
+    NONE = 0
+    AVERAGE = 1
+    SUM = 2
+    COUNT = 3
+
+
+class AverageMeter:
+    """Computes and stores the average and current value."""
+
+    def __init__(self, name="", fmt=":f", summary_type=Summary.AVERAGE):
+        self.name = name
+        self.fmt = fmt
+        self.summary_type = summary_type
+        self.reset()
+
+    def reset(self):
+        self.val = 0
+        self.avg = 0
+        self.sum = 0
+        self.count = 0
+
+    def update(self, val, n=1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+
+    def __str__(self):
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(**self.__dict__)
+
+    def summary(self):
+        if self.summary_type is Summary.NONE:
+            fmtstr = ""
+        elif self.summary_type is Summary.AVERAGE:
+            fmtstr = "{name} {avg:.3f}"
+        elif self.summary_type is Summary.SUM:
+            fmtstr = "{name} {sum:.3f}"
+        elif self.summary_type is Summary.COUNT:
+            fmtstr = "{name} {count:.3f}"
+        else:
+            raise ValueError("invalid summary type %r" % self.summary_type)
+        return fmtstr.format(**self.__dict__)
+
+
+class ProgressMeter:
+    def __init__(self, num_batches, meters, prefix=""):
+        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch):
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(meter) for meter in self.meters]
+        print("\t".join(entries))
+
+    def display_summary(self):
+        entries = [" *"]
+        entries += [meter.summary() for meter in self.meters]
+        print(" ".join(entries))
+
+    @staticmethod
+    def _get_batch_fmtstr(num_batches):
+        num_digits = len(str(num_batches // 1))
+        fmt = "{:" + str(num_digits) + "d}"
+        return "[" + fmt + "/" + fmt.format(num_batches) + "]"

@@ -1,0 +1,58 @@
+"""Meter parity tests (reference imagenet_ddp.py:333-371; nd_imagenet.py:361-421)."""
+
+from dptpu.utils.meters import AverageMeter, ProgressMeter, Summary
+
+
+def test_average_meter_running_stats():
+    m = AverageMeter("Loss", ":.4e")
+    m.update(2.0)
+    m.update(4.0, n=3)
+    assert m.val == 4.0
+    assert m.sum == 2.0 + 12.0
+    assert m.count == 4
+    assert m.avg == 14.0 / 4
+
+
+def test_average_meter_str_format():
+    m = AverageMeter("Acc@1", ":6.2f")
+    m.update(12.5)
+    assert str(m) == "Acc@1  12.50 ( 12.50)"
+
+
+def test_average_meter_reset():
+    m = AverageMeter("Time", ":6.3f")
+    m.update(1.0)
+    m.reset()
+    assert (m.val, m.avg, m.sum, m.count) == (0, 0, 0, 0)
+
+
+def test_summary_variants():
+    m = AverageMeter("Acc@5", ":6.2f", summary_type=Summary.AVERAGE)
+    m.update(50.0)
+    m.update(100.0)
+    assert m.summary() == "Acc@5 75.000"
+    m.summary_type = Summary.SUM
+    assert m.summary() == "Acc@5 150.000"
+    m.summary_type = Summary.COUNT
+    assert m.summary() == "Acc@5 2.000"
+    m.summary_type = Summary.NONE
+    assert m.summary() == ""
+
+
+def test_progress_meter_display(capsys):
+    m = AverageMeter("Loss", ":.4e")
+    m.update(0.5)
+    p = ProgressMeter(100, [m], prefix="Epoch: [3]")
+    p.display(7)
+    out = capsys.readouterr().out
+    # Reference format: "Epoch: [3][  7/100]\tLoss 5.0000e-01 (5.0000e-01)"
+    assert out == "Epoch: [3][  7/100]\tLoss 5.0000e-01 (5.0000e-01)\n"
+
+
+def test_progress_meter_display_summary(capsys):
+    m = AverageMeter("Acc@1", ":6.2f")
+    m.update(10.0)
+    p = ProgressMeter(10, [m], prefix="Test: ")
+    p.display_summary()
+    out = capsys.readouterr().out
+    assert out == " * Acc@1 10.000\n"

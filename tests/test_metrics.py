@@ -1,0 +1,63 @@
+"""Top-k accuracy vs reference semantics (imagenet_ddp.py:381-395)."""
+
+import numpy as np
+import pytest
+
+from dptpu.ops.loss import cross_entropy_loss
+from dptpu.ops.metrics import accuracy, topk_correct_fraction
+
+
+def test_topk_exact_small_case():
+    logits = np.array(
+        [
+            [9.0, 1.0, 0.0, 0.0],  # pred 0, label 0 → top1 hit
+            [1.0, 9.0, 8.0, 0.0],  # pred 1, label 2 → top1 miss, top2 hit
+            [5.0, 4.0, 3.0, 2.0],  # pred 0, label 3 → miss all top3
+        ],
+        dtype=np.float32,
+    )
+    labels = np.array([0, 2, 3])
+    acc1, acc5 = accuracy(logits, labels, topk=(1, 2))
+    assert float(acc1) == pytest.approx(100.0 * 1 / 3, rel=1e-6)
+    assert float(acc5) == pytest.approx(100.0 * 2 / 3, rel=1e-6)
+
+
+def test_topk_matches_torch_reference_impl():
+    torch = __import__("torch")
+    rng = np.random.RandomState(0)
+    logits = rng.randn(64, 1000).astype(np.float32)
+    labels = rng.randint(0, 1000, size=64)
+
+    # reference implementation (imagenet_ddp.py:381-395), verbatim semantics
+    t_out, t_tgt = torch.from_numpy(logits), torch.from_numpy(labels)
+    _, pred = t_out.topk(5, 1, True, True)
+    pred = pred.t()
+    correct = pred.eq(t_tgt.view(1, -1).expand_as(pred))
+    ref = [
+        float(correct[:k].reshape(-1).float().sum(0) * (100.0 / 64)) for k in (1, 5)
+    ]
+
+    ours = [float(a) for a in accuracy(logits, labels, topk=(1, 5))]
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+def test_fraction_bounds():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(32, 10).astype(np.float32)
+    labels = rng.randint(0, 10, size=32)
+    f1, f5 = topk_correct_fraction(logits, labels, (1, 5))
+    assert 0.0 <= float(f1) <= float(f5) <= 1.0
+
+
+def test_cross_entropy_matches_torch():
+    torch = __import__("torch")
+    rng = np.random.RandomState(2)
+    logits = rng.randn(16, 10).astype(np.float32)
+    labels = rng.randint(0, 10, size=16)
+    ref = float(
+        torch.nn.functional.cross_entropy(
+            torch.from_numpy(logits), torch.from_numpy(labels)
+        )
+    )
+    ours = float(cross_entropy_loss(logits, labels))
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)

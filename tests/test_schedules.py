@@ -1,0 +1,52 @@
+"""LR schedule math vs the reference formulas (imagenet_ddp.py:374-378;
+imagenet_ddp_apex.py:161-162,527-543)."""
+
+import pytest
+
+from dptpu.ops.schedules import (
+    scale_lr_linear,
+    step_decay_lr,
+    warmup_step_decay_lr,
+)
+
+
+@pytest.mark.parametrize(
+    "epoch,expected_factor",
+    [(0, 1.0), (29, 1.0), (30, 0.1), (59, 0.1), (60, 0.01), (89, 0.01), (90, 0.001)],
+)
+def test_step_decay(epoch, expected_factor):
+    assert step_decay_lr(0.1, epoch) == pytest.approx(0.1 * expected_factor)
+
+
+def test_apex_decay_extra_factor_at_80():
+    # epoch 80: factor = 80//30 + 1 = 3 → lr = 0.1 * 1e-3
+    assert warmup_step_decay_lr(0.1, 80, 1, 100) == pytest.approx(0.1 * 1e-3)
+    # epoch 79: factor = 2
+    assert warmup_step_decay_lr(0.1, 79, 1, 100) == pytest.approx(0.1 * 1e-2)
+
+
+def test_apex_warmup_linear_in_global_step():
+    base, len_epoch = 0.4, 100
+    # reference: lr * (1 + step + epoch*len_epoch) / (5*len_epoch)
+    for epoch in range(5):
+        for step in (1, 50, 100):
+            got = warmup_step_decay_lr(base, epoch, step, len_epoch)
+            want = base * float(1 + step + epoch * len_epoch) / (5.0 * len_epoch)
+            assert got == pytest.approx(want)
+    # warmup reaches ~base at end of epoch 4 and is exact beyond
+    assert warmup_step_decay_lr(base, 5, 1, len_epoch) == pytest.approx(base)
+
+
+def test_warmup_is_monotonic_until_epoch5():
+    prev = 0.0
+    for epoch in range(5):
+        for step in range(1, 101):
+            lr = warmup_step_decay_lr(0.4, epoch, step, 100)
+            assert lr > prev
+            prev = lr
+
+
+def test_linear_scaling_rule():
+    # imagenet_ddp_apex.py:162 — lr * batch*world/256
+    assert scale_lr_linear(0.1, 224 * 16) == pytest.approx(0.1 * 224 * 16 / 256.0)
+    assert scale_lr_linear(0.1, 256) == pytest.approx(0.1)
