@@ -1,0 +1,13 @@
+"""In-tree Flax model zoo with torchvision registry semantics.
+
+Importing this package populates the registry (the analog of torchvision's
+module-dict discovery, imagenet_ddp.py:19-21). ``model_names()`` and
+``create_model()`` are the CLI-facing surface.
+"""
+
+from dptpu.models import alexnet as _alexnet  # noqa: F401
+from dptpu.models import resnet as _resnet  # noqa: F401
+from dptpu.models import vgg as _vgg  # noqa: F401
+from dptpu.models.registry import create_model, model_names, register_model
+
+__all__ = ["create_model", "model_names", "register_model"]

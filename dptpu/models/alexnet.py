@@ -1,0 +1,86 @@
+"""AlexNet, torchvision-architecture-exact, NHWC.
+
+Reference uses ``torchvision.models.alexnet`` (discoverable via
+imagenet_ddp.py:19-21; the AlexNet/VGG DataParallel special case is
+nd_imagenet.py:163-169, and BASELINE.md config 4 runs it with lr=0.01).
+Architecture: 5-conv feature stack with 3 max pools → adaptive 6×6 average
+pool → Dropout/4096/4096/num_classes classifier. torchvision applies no
+custom init to AlexNet, so every layer uses torch's default
+kaiming-uniform(a=√5) kernel + U(±1/√fan_in) bias — reproduced here.
+Parameter count (61,100,840) is locked in tests/test_models.py.
+"""
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    adaptive_avg_pool,
+    max_pool_same_as_torch,
+    torch_default_bias_init,
+    torch_default_kernel_init,
+)
+from dptpu.models.registry import register_model
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Any = None  # no BN in AlexNet; accepted for API uniformity
+
+    def _conv(self, features, kernel, stride, padding, in_features, name):
+        return nn.Conv(
+            features,
+            (kernel, kernel),
+            strides=(stride, stride),
+            padding=((padding, padding), (padding, padding)),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=torch_default_kernel_init,
+            bias_init=torch_default_bias_init(in_features * kernel * kernel),
+            name=name,
+        )
+
+    def _dense(self, features, fan_in, name):
+        return nn.Dense(
+            features,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=torch_default_kernel_init,
+            bias_init=torch_default_bias_init(fan_in),
+            name=name,
+        )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = self._conv(64, 11, 4, 2, 3, "features_0")(x)
+        x = nn.relu(x)
+        x = max_pool_same_as_torch(x, 3, 2, 0)
+        x = self._conv(192, 5, 1, 2, 64, "features_3")(x)
+        x = nn.relu(x)
+        x = max_pool_same_as_torch(x, 3, 2, 0)
+        x = self._conv(384, 3, 1, 1, 192, "features_6")(x)
+        x = nn.relu(x)
+        x = self._conv(256, 3, 1, 1, 384, "features_8")(x)
+        x = nn.relu(x)
+        x = self._conv(256, 3, 1, 1, 256, "features_10")(x)
+        x = nn.relu(x)
+        x = max_pool_same_as_torch(x, 3, 2, 0)
+        x = adaptive_avg_pool(x, 6)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = self._dense(4096, 256 * 6 * 6, "classifier_1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = self._dense(4096, 4096, "classifier_4")(x)
+        x = nn.relu(x)
+        x = self._dense(self.num_classes, 4096, "classifier_6")(x)
+        return x
+
+
+@register_model
+def alexnet(**kw):
+    return AlexNet(**kw)

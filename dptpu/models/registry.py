@@ -1,0 +1,37 @@
+"""Model registry with torchvision discovery semantics.
+
+The reference discovers architectures as "any lowercase, non-dunder,
+callable name in ``torchvision.models.__dict__``" (imagenet_ddp.py:19-21)
+and instantiates with ``models.__dict__[args.arch]()``
+(imagenet_ddp.py:111-114). This registry reproduces that contract for the
+in-tree Flax zoo: ``model_names()`` feeds the CLI ``choices`` and
+``create_model(name)`` is the ``models.__dict__[arch]()`` analog.
+"""
+
+_REGISTRY = {}
+
+
+def register_model(fn):
+    """Decorator: register a lowercase factory under its function name."""
+    name = fn.__name__
+    assert name.islower() and not name.startswith("__")
+    _REGISTRY[name] = fn
+    return fn
+
+
+def model_names():
+    """Sorted architecture names (imagenet_ddp.py:19-21 semantics)."""
+    return sorted(_REGISTRY)
+
+
+def create_model(name, pretrained=False, **kwargs):
+    """``models.__dict__[arch](pretrained=...)`` analog (imagenet_ddp.py:108-114)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown architecture {name!r}; choices: {model_names()}")
+    if pretrained:
+        raise RuntimeError(
+            "--pretrained requires downloading torchvision weights, which is "
+            "unavailable in this environment; train from scratch or --resume "
+            "from a dptpu checkpoint instead"
+        )
+    return _REGISTRY[name](**kwargs)

@@ -1,0 +1,137 @@
+"""VGG family (11/13/16/19, ± BatchNorm), torchvision-architecture-exact, NHWC.
+
+Reference uses ``torchvision.models.vgg*`` via the arch registry
+(imagenet_ddp.py:19-21,108-114); BASELINE.md config 4 exercises VGG-16 with
+lr=0.01 (the no-BN path — the same reason nd_imagenet.py:163-169 wraps only
+``model.features`` in DataParallel for these nets). Configs A/B/D/E are the
+standard torchvision tables; classifier is 512·7·7 → 4096 → 4096 → classes
+with dropout. Init matches torchvision's ``_initialize_weights``:
+kaiming-normal(fan_out) convs with zero bias, N(0, 0.01) classifier kernels
+with zero bias, BN γ=1/β=0. Parameter counts are locked in
+tests/test_models.py.
+"""
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    adaptive_avg_pool,
+    kaiming_normal_fan_out,
+    max_pool_same_as_torch,
+)
+from dptpu.models.registry import register_model
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    batch_norm: bool = False
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        layer_idx = 0
+        for v in self.cfg:
+            if v == "M":
+                x = max_pool_same_as_torch(x, 2, 2, 0)
+                layer_idx += 1
+                continue
+            x = nn.Conv(
+                v,
+                (3, 3),
+                padding=((1, 1), (1, 1)),
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=kaiming_normal_fan_out,
+                bias_init=nn.initializers.zeros,
+                name=f"features_{layer_idx}",
+            )(x)
+            layer_idx += 1
+            if self.batch_norm:
+                x = nn.BatchNorm(
+                    use_running_average=not train,
+                    momentum=0.9,
+                    epsilon=1e-5,
+                    dtype=jnp.float32,
+                    param_dtype=jnp.float32,
+                    axis_name=self.bn_axis_name,
+                    name=f"features_{layer_idx}",
+                )(x)
+                layer_idx += 1
+            x = nn.relu(x)
+            layer_idx += 1  # the ReLU slot in torchvision's Sequential numbering
+        x = adaptive_avg_pool(x, 7)
+        x = x.reshape((x.shape[0], -1))
+        dense = lambda features, name: nn.Dense(  # noqa: E731
+            features,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(stddev=0.01),
+            bias_init=nn.initializers.zeros,
+            name=name,
+        )
+        x = dense(4096, "classifier_0")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = dense(4096, "classifier_3")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = dense(self.num_classes, "classifier_6")(x)
+        return x
+
+
+def _vgg(cfg, batch_norm, **kw):
+    return VGG(cfg=tuple(_CFGS[cfg]), batch_norm=batch_norm, **kw)
+
+
+@register_model
+def vgg11(**kw):
+    return _vgg("A", False, **kw)
+
+
+@register_model
+def vgg11_bn(**kw):
+    return _vgg("A", True, **kw)
+
+
+@register_model
+def vgg13(**kw):
+    return _vgg("B", False, **kw)
+
+
+@register_model
+def vgg13_bn(**kw):
+    return _vgg("B", True, **kw)
+
+
+@register_model
+def vgg16(**kw):
+    return _vgg("D", False, **kw)
+
+
+@register_model
+def vgg16_bn(**kw):
+    return _vgg("D", True, **kw)
+
+
+@register_model
+def vgg19(**kw):
+    return _vgg("E", False, **kw)
+
+
+@register_model
+def vgg19_bn(**kw):
+    return _vgg("E", True, **kw)

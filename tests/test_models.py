@@ -1,0 +1,119 @@
+"""Model zoo structural parity: parameter counts must equal torchvision's
+(the reference's model source, imagenet_ddp.py:108-114), output shapes must
+be [batch, num_classes], and BN state must exist exactly where expected."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dptpu.models import create_model, model_names
+
+# Exact torchvision parameter counts (weights + biases + BN affine;
+# excluding BN running stats, which live in a separate collection here
+# just as they are non-Parameter buffers in torch).
+TORCHVISION_PARAM_COUNTS = {
+    "resnet18": 11_689_512,
+    "resnet34": 21_797_672,
+    "resnet50": 25_557_032,
+    "resnet101": 44_549_160,
+    "resnet152": 60_192_808,
+    "alexnet": 61_100_840,
+    "vgg11": 132_863_336,
+    "vgg11_bn": 132_868_840,
+    "vgg13": 133_047_848,
+    "vgg13_bn": 133_053_736,
+    "vgg16": 138_357_544,
+    "vgg16_bn": 138_365_992,
+    "vgg19": 143_667_240,
+    "vgg19_bn": 143_678_248,
+}
+
+
+def _init(name, image=64):
+    model = create_model(name)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3), jnp.float32)
+    )
+    return model, variables
+
+
+def _count(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+@pytest.mark.parametrize("name", ["resnet18", "resnet50", "resnet152"])
+def test_resnet_param_counts(name):
+    _, variables = _init(name)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+@pytest.mark.parametrize("name", ["resnet34", "resnet101"])
+def test_resnet_param_counts_slow(name):
+    _, variables = _init(name)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+def test_alexnet_param_count():
+    _, variables = _init("alexnet", image=224)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS["alexnet"]
+
+
+@pytest.mark.parametrize("name", ["vgg11", "vgg16", "vgg16_bn", "vgg19_bn"])
+def test_vgg_param_counts(name):
+    _, variables = _init(name, image=224)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+def test_registry_surface():
+    names = model_names()
+    assert names == sorted(names)
+    for required in ("resnet18", "resnet50", "resnet152", "alexnet", "vgg16"):
+        assert required in names
+
+
+def test_pretrained_flag_raises():
+    with pytest.raises(RuntimeError, match="pretrained"):
+        create_model("resnet50", pretrained=True)
+
+
+def test_resnet_forward_shapes_and_finite():
+    model, variables = _init("resnet18")
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    logits = model.apply(variables, x)
+    assert logits.shape == (2, 1000)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_resnet_train_mode_updates_batch_stats():
+    model, variables = _init("resnet18")
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 64, 64, 3)) + 3.0
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_num_classes_override():
+    model = create_model("resnet18", num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    logits = model.apply(variables, jnp.zeros((2, 64, 64, 3)))
+    assert logits.shape == (2, 10)
+
+
+def test_bf16_compute_dtype_keeps_fp32_params():
+    model = create_model("resnet18", dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    kernels = jax.tree_util.tree_leaves(variables["params"])
+    assert all(k.dtype == jnp.float32 for k in kernels)
+    logits = model.apply(variables, jnp.zeros((2, 64, 64, 3), jnp.bfloat16))
+    assert logits.dtype == jnp.bfloat16
+
+
+def test_dropout_models_need_rng_in_train():
+    model, variables = _init("alexnet", image=224)
+    x = jnp.zeros((2, 224, 224, 3))
+    out = model.apply(
+        variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(3)}
+    )
+    assert out.shape == (2, 1000)
