@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Headline benchmark: ResNet-50 train-step throughput, images/sec/chip.
+
+Runs the full compiled training step (uint8 batch → on-device normalize →
+forward → backward → SGD update, bf16 compute like the Apex path) on
+synthetic data on every visible chip and reports images/sec/chip — the
+reference's own throughput definition, world·batch/time ÷ chips
+(imagenet_ddp_apex.py:411-412).
+
+Baseline for ``vs_baseline``: ~2800 images/sec/chip, the public ballpark for
+A100 + AMP + NCCL-DDP ResNet-50/224 training — the "≥ A100x32 NCCL-DDP
+images/sec/chip" bar from BASELINE.json's north star (no reference-published
+number exists; SURVEY.md §6).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 2800.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from dptpu.models import create_model
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.parallel import make_mesh, shard_host_batch
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    n_chips = jax.device_count()
+    per_chip_batch = 128
+    global_batch = per_chip_batch * n_chips
+
+    mesh = make_mesh() if n_chips > 1 else None
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    step = make_train_step(
+        mesh, jnp.bfloat16, lr_schedule=make_step_decay_schedule(0.1, 100)
+    )
+
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "images": rng.randint(0, 256, (global_batch, 224, 224, 3)).astype(
+            np.uint8
+        ),
+        "labels": rng.randint(0, 1000, (global_batch,)).astype(np.int32),
+    }
+    batch = (
+        shard_host_batch(host_batch, mesh)
+        if mesh is not None
+        else jax.device_put(host_batch)
+    )
+
+    # warmup: compile + 3 steps; end on a VALUE fetch — on relayed/remote
+    # PJRT backends block_until_ready can return before execution finishes,
+    # so only a device→host scalar read is a trustworthy timing fence
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # timing fence: depends on every queued step
+    dt = time.perf_counter() - t0
+
+    img_per_sec = global_batch * iters / dt
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_bf16_train_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

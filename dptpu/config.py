@@ -1,0 +1,254 @@
+"""Config layer: the reference's three argparse surfaces, made immutable.
+
+The reference carries three near-identical CLI schemas (imagenet_ddp.py:23-67;
+imagenet_ddp_apex.py:42-98; nd_imagenet.py:26-76) and then *mutates* the
+parsed ``args`` at runtime (per-GPU batch/worker rescaling
+imagenet_ddp.py:125-126, linear LR scaling imagenet_ddp_apex.py:161-162,
+world-size rescaling imagenet_ddp.py:76-81). Here the same flags parse into a
+frozen :class:`Config` and every derived quantity is computed once, purely, in
+:class:`DerivedConfig` — nothing downstream ever rewrites configuration.
+
+CUDA-specific flags (``--dist-backend nccl``, ``--opt-level O2``,
+``--loss-scale``, ``--channels-last``, ``--gpu``) are **accepted and mapped,
+never a crash** (SURVEY.md §7 hard part (e)): on TPU, NCCL becomes XLA ICI/DCN
+collectives, any Apex opt-level ≥ O1 becomes the bf16 compute policy (loss
+scaling is unnecessary in bf16 — same exponent range as fp32), channels_last
+is a no-op because the zoo is already NHWC, and ``--gpu`` pins
+``jax.local_devices()[gpu]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import math
+import os
+from typing import Optional
+
+# Flag spec table: (args, kwargs) per flag, keyed by which CLI variants carry
+# it. Variants: "ddp" = imagenet_ddp.py, "apex" = imagenet_ddp_apex.py,
+# "nd" = nd_imagenet.py. Defaults that differ per variant are resolved in
+# build_parser.
+_VARIANTS = ("ddp", "apex", "nd")
+
+# Per-variant default overrides (reference: arch resnet18 + batch 256 in nd,
+# nd_imagenet.py:29,40; batch 224 *per GPU* in apex, imagenet_ddp_apex.py:63-67).
+_DEFAULTS = {
+    "ddp": {"arch": "resnet50", "batch_size": 1024},
+    "apex": {"arch": "resnet50", "batch_size": 224},
+    "nd": {"arch": "resnet18", "batch_size": 256},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Union of the three reference CLI schemas, immutable.
+
+    Field names follow the reference's ``dest`` names exactly so downstream
+    code reads like the reference's ``args.*`` accesses.
+    """
+
+    data: str
+    arch: str = "resnet50"
+    workers: int = 4
+    epochs: int = 90
+    start_epoch: int = 0
+    batch_size: int = 1024
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    print_freq: int = 10
+    resume: str = ""
+    evaluate: bool = False
+    pretrained: bool = False
+    # distributed (ddp/nd; apex uses env:// exclusively)
+    world_size: int = -1
+    rank: int = -1
+    dist_url: str = "tcp://224.66.41.62:23456"
+    dist_backend: str = "nccl"
+    desired_acc: Optional[float] = None
+    # nd extras (nd_imagenet.py:68-76)
+    seed: Optional[int] = None
+    gpu: Optional[int] = None
+    multiprocessing_distributed: bool = False
+    # apex extras (imagenet_ddp_apex.py:88-95)
+    local_rank: int = 0
+    sync_bn: bool = False
+    opt_level: Optional[str] = None
+    keep_batchnorm_fp32: Optional[str] = None
+    loss_scale: Optional[str] = None
+    channels_last: bool = False
+    # which CLI variant parsed this config (drives batch semantics + schedule)
+    variant: str = "ddp"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentParser:
+    """Build the argparse surface for one reference CLI variant.
+
+    Flag names, aliases, types, and defaults match the reference schema for
+    that variant (SURVEY.md §2 #1/#12/#20) so published run commands
+    (README.md:64-99) parse unchanged.
+    """
+    if variant not in _VARIANTS:
+        raise ValueError(f"variant must be one of {_VARIANTS}, got {variant!r}")
+    if model_names is None:
+        from dptpu.models import model_names as _mn
+
+        model_names = _mn()
+    d = _DEFAULTS[variant]
+
+    p = argparse.ArgumentParser(description="TPU-native ImageNet Training (dptpu)")
+    p.add_argument("data", metavar="DIR", help="path to dataset")
+    p.add_argument(
+        "-a",
+        "--arch",
+        metavar="ARCH",
+        default=d["arch"],
+        choices=model_names,
+        help="model architecture: " + " | ".join(model_names),
+    )
+    p.add_argument("-j", "--workers", default=4, type=int, metavar="N",
+                   help="number of data loading workers")
+    p.add_argument("--epochs", default=90, type=int, metavar="N")
+    p.add_argument("--start-epoch", default=0, type=int, metavar="N",
+                   help="manual epoch number (useful on restarts)")
+    batch_help = (
+        "per-device mini-batch size"
+        if variant == "apex"
+        else "total batch size across all local devices"
+    )
+    p.add_argument("-b", "--batch-size", default=d["batch_size"], type=int,
+                   metavar="N", help=batch_help)
+    p.add_argument("--lr", "--learning-rate", default=0.1, type=float,
+                   metavar="LR", dest="lr", help="initial learning rate")
+    p.add_argument("--momentum", default=0.9, type=float, metavar="M")
+    p.add_argument("--wd", "--weight-decay", default=1e-4, type=float,
+                   metavar="W", dest="weight_decay")
+    p.add_argument("-p", "--print-freq", default=10, type=int, metavar="N")
+    p.add_argument("--resume", default="", type=str, metavar="PATH",
+                   help="path to latest checkpoint")
+    p.add_argument("-e", "--evaluate", dest="evaluate", action="store_true",
+                   help="evaluate model on validation set")
+    p.add_argument("--pretrained", dest="pretrained", action="store_true")
+
+    if variant in ("ddp", "nd"):
+        p.add_argument("--world-size", default=-1, type=int,
+                       help="number of nodes for distributed training")
+        p.add_argument("--rank", default=-1, type=int,
+                       help="node rank for distributed training")
+        p.add_argument("--dist-url", default="tcp://224.66.41.62:23456",
+                       type=str, help="rendezvous url (host:port of node 0)")
+        p.add_argument("--dist-backend", default="nccl", type=str,
+                       help="accepted for CLI parity; TPU always uses XLA "
+                            "collectives over ICI/DCN")
+    if variant == "ddp":
+        p.add_argument("--desired-acc", default=None, type=float,
+                       help="stop training after desired-acc is reached")
+    if variant == "nd":
+        p.add_argument("--seed", default=None, type=int,
+                       help="seed for initializing training")
+        p.add_argument("--gpu", default=None, type=int,
+                       help="device id to pin (single-device mode)")
+        p.add_argument("--multiprocessing-distributed", action="store_true")
+    if variant == "apex":
+        p.add_argument("--local_rank", default=0, type=int)
+        p.add_argument("--sync-bn", action="store_true",
+                       help="cross-replica BatchNorm statistics")
+        p.add_argument("--opt-level", type=str, default=None,
+                       help="Apex O0-O3; O1+ maps to the bf16 compute policy")
+        p.add_argument("--keep-batchnorm-fp32", type=str, default=None)
+        p.add_argument("--loss-scale", type=str, default=None,
+                       help="accepted for parity; bf16 needs no loss scaling")
+        # type=bool quirk preserved: any non-empty value parses truthy,
+        # matching the reference flag exactly (imagenet_ddp_apex.py:95)
+        p.add_argument("--channels-last", type=bool, default=False,
+                       help="no-op: dptpu models are NHWC already")
+    return p
+
+
+def parse_config(argv=None, variant: str = "ddp") -> Config:
+    """Parse argv through the variant's reference-parity schema into a Config."""
+    ns = build_parser(variant).parse_args(argv)
+    fields = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in vars(ns).items() if k in fields}
+    return Config(variant=variant, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class DerivedConfig:
+    """Every runtime-derived quantity, computed once and immutably.
+
+    Replaces the reference's in-place args mutation:
+
+    * ``world_size = ngpus_per_node * nnodes``  (imagenet_ddp.py:76-81)
+    * ``rank = node_rank * ngpus + gpu``        (imagenet_ddp.py:103)
+    * ``batch_size //= ngpus``                  (imagenet_ddp.py:125)
+    * ``workers = ceil(workers / ngpus)``       (imagenet_ddp.py:126)
+    * ``lr *= global_batch/256`` (apex only)    (imagenet_ddp_apex.py:161-162)
+    """
+
+    num_processes: int  # hosts (JAX processes), = reference's nnodes
+    process_index: int  # this host's index, = node rank
+    local_device_count: int  # chips on this host, = ngpus_per_node
+    global_device_count: int  # total chips, = reference world_size after rescale
+    per_device_batch_size: int
+    global_batch_size: int
+    per_host_batch_size: int
+    workers_per_device: int
+    scaled_lr: float
+    use_bf16: bool
+    sync_bn: bool
+    distributed: bool
+
+    @property
+    def is_chief(self) -> bool:
+        """Single-writer guard, the ``rank % ngpus_per_node == 0`` /
+        rank-0 analog (imagenet_ddp.py:215; imagenet_ddp_apex.py:268)."""
+        return self.process_index == 0
+
+
+def derive(cfg: Config, *, local_device_count: int,
+           num_processes: int = 1, process_index: int = 0) -> DerivedConfig:
+    """Compute the DerivedConfig for this host.
+
+    Batch semantics per variant (the reference's own split):
+      * ddp/nd: ``-b`` is the total batch for all local devices
+        (imagenet_ddp.py:37-41) → per-device = b // local_devices.
+      * apex: ``-b`` is already per-device (imagenet_ddp_apex.py:63-67).
+    """
+    n_local = local_device_count
+    global_devices = n_local * num_processes
+    if cfg.variant == "apex":
+        per_device = cfg.batch_size
+    else:
+        per_device = max(1, cfg.batch_size // n_local)
+    global_batch = per_device * global_devices
+
+    use_bf16 = cfg.variant == "apex" and (cfg.opt_level or "O2") != "O0"
+    scaled_lr = cfg.lr
+    if cfg.variant == "apex":
+        scaled_lr = cfg.lr * float(global_batch) / 256.0
+
+    distributed = (
+        num_processes > 1
+        or cfg.world_size > 1
+        or cfg.multiprocessing_distributed
+        or int(os.environ.get("WORLD_SIZE", "1")) > 1
+    )
+    return DerivedConfig(
+        num_processes=num_processes,
+        process_index=process_index,
+        local_device_count=n_local,
+        global_device_count=global_devices,
+        per_device_batch_size=per_device,
+        global_batch_size=global_batch,
+        per_host_batch_size=per_device * n_local,
+        workers_per_device=int(math.ceil(cfg.workers / n_local)),
+        scaled_lr=scaled_lr,
+        use_bf16=use_bf16,
+        sync_bn=cfg.sync_bn,
+        distributed=distributed,
+    )

@@ -1,0 +1,35 @@
+"""Input pipeline: ImageFolder reader, transforms, sharded sampling, prefetch.
+
+TPU-native L4 (SURVEY.md §1): torchvision's ImageFolder + transform stacks +
+DistributedSampler + the Apex fast_collate/DataPrefetcher become an in-tree
+host pipeline — per-host disjoint shards, thread-pool JPEG decode, uint8
+NHWC collation (normalization stays on-device, fused into the train step),
+and a double-buffered device prefetcher that overlaps host decode + H2D with
+the running step.
+"""
+
+from dptpu.data.dataset import ImageFolderDataset, SyntheticDataset
+from dptpu.data.loader import DataLoader, DevicePrefetcher
+from dptpu.data.sampler import ShardedSampler
+from dptpu.data.transforms import (
+    center_crop,
+    random_horizontal_flip,
+    random_resized_crop,
+    resize_shorter,
+    train_transform,
+    val_transform,
+)
+
+__all__ = [
+    "DataLoader",
+    "DevicePrefetcher",
+    "ImageFolderDataset",
+    "ShardedSampler",
+    "SyntheticDataset",
+    "center_crop",
+    "random_horizontal_flip",
+    "random_resized_crop",
+    "resize_shorter",
+    "train_transform",
+    "val_transform",
+]

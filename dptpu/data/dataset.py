@@ -1,0 +1,104 @@
+"""Datasets: ImageFolder-layout reader + synthetic stand-in.
+
+``ImageFolderDataset`` reproduces ``datasets.ImageFolder`` semantics
+(reference imagenet_ddp.py:166-173): one subdirectory per class under the
+root, class index = position in the *sorted* subdirectory list, every image
+file inside belongs to that class. Decoding is PIL (RGB), matching
+torchvision's default loader.
+
+``SyntheticDataset`` generates deterministic random uint8 images — the
+fixture for integration tests and throughput benchmarks (it removes host
+decode from the measurement, isolating the device-side number).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+_IMG_EXTENSIONS = (
+    ".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif", ".tiff", ".webp",
+)
+
+
+class ImageFolderDataset:
+    """root/<class_name>/<image> layout, torchvision class-index semantics."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: list[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, filenames in sorted(os.walk(cdir)):
+                for fn in sorted(filenames):
+                    if fn.lower().endswith(_IMG_EXTENSIONS):
+                        self.samples.append(
+                            (os.path.join(dirpath, fn), self.class_to_idx[c])
+                        )
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root!r}")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def get(self, index: int, rng: Optional[np.random.Generator] = None):
+        """Load + transform one sample; ``rng`` drives any augmentation
+        randomness (per-item, loader-provided — see DataLoader)."""
+        from PIL import Image
+
+        path, label = self.samples[index]
+        with Image.open(path) as img:
+            img = img.convert("RGB")
+            if self.transform is None:
+                out = np.asarray(img)
+            else:
+                out = self.transform(
+                    img, rng if rng is not None else np.random.default_rng(index)
+                )
+        return out, label
+
+    def __getitem__(self, index: int):
+        return self.get(index)
+
+
+class SyntheticDataset:
+    """Deterministic random uint8 HWC images; index-stable across epochs."""
+
+    def __init__(self, num_samples: int = 1024, image_size: int = 224,
+                 num_classes: int = 1000, transform: Optional[Callable] = None):
+        self.num_samples = num_samples
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def get(self, index: int, rng: Optional[np.random.Generator] = None):
+        data_rng = np.random.RandomState(index % self.num_samples)
+        img = data_rng.randint(
+            0, 256, (self.image_size, self.image_size, 3), dtype=np.uint8
+        )
+        label = int(data_rng.randint(0, self.num_classes))
+        if self.transform:
+            from PIL import Image
+
+            img = self.transform(
+                Image.fromarray(img),
+                rng if rng is not None else np.random.default_rng(index),
+            )
+        return img, label
+
+    def __getitem__(self, index: int):
+        return self.get(index)

@@ -1,0 +1,52 @@
+"""Per-host disjoint sharding with per-epoch reshuffle.
+
+``ShardedSampler`` is the DistributedSampler contract (reference
+imagenet_ddp.py:175-183; README.md:61): each shard (here: each *host* — chips
+on a host share one process, SURVEY.md §1 L1) sees a disjoint 1/N slice,
+padded by wrap-around so every shard draws the same number of samples, and
+the permutation is reseeded from ``(seed, epoch)`` — the
+``train_sampler.set_epoch(epoch)`` analog (imagenet_ddp.py:202) made
+explicit: ``epoch`` is an argument, not mutable sampler state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShardedSampler:
+    def __init__(self, num_examples: int, num_shards: int = 1,
+                 shard_index: int = 0, shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        if drop_last:
+            self.samples_per_shard = num_examples // num_shards
+        else:
+            self.samples_per_shard = -(-num_examples // num_shards)  # ceil
+
+    def __len__(self) -> int:
+        return self.samples_per_shard
+
+    def indices(self, epoch: int = 0) -> np.ndarray:
+        """This shard's index slice for ``epoch`` (set_epoch analog)."""
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + epoch).permutation(
+                self.num_examples
+            )
+        else:
+            order = np.arange(self.num_examples)
+        total = self.samples_per_shard * self.num_shards
+        if total > order.size:  # pad by wrap-around (DistributedSampler)
+            order = np.concatenate([order, order[: total - order.size]])
+        else:
+            order = order[:total]
+        # interleaved assignment: shard i takes order[i::num_shards],
+        # so shards stay disjoint for any epoch
+        return order[self.shard_index::self.num_shards]

@@ -1,0 +1,101 @@
+"""Host-side image transforms with torchvision-exact sampling math.
+
+Train stack = RandomResizedCrop(224) → RandomHorizontalFlip; val stack =
+Resize(256) → CenterCrop(224) (reference imagenet_ddp.py:163-194). ToTensor +
+Normalize are deliberately ABSENT: like the Apex fast path ("Too slow" on
+CPU, imagenet_ddp_apex.py:215-226), output stays uint8 HWC and normalization
+happens on-device inside the compiled step (dptpu.train.step.normalize_images).
+
+All randomness flows through an explicit ``numpy.random.Generator`` so a
+seeded run is reproducible end-to-end (the ``--seed`` contract,
+nd_imagenet.py:68-69,84-92) without any process-global RNG state.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+_BILINEAR = 2  # PIL.Image.BILINEAR
+
+
+def random_resized_crop(img, rng, size=224, scale=(0.08, 1.0),
+                        ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """torchvision RandomResizedCrop: area ~ U(scale)·A, log-uniform aspect,
+    10 attempts, then the aspect-clamped center-crop fallback."""
+    w, h = img.size
+    area = w * h
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        cw = int(round(math.sqrt(target_area * aspect)))
+        ch = int(round(math.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            left = int(rng.integers(0, w - cw + 1))
+            top = int(rng.integers(0, h - ch + 1))
+            return img.resize(
+                (size, size), _BILINEAR, box=(left, top, left + cw, top + ch)
+            )
+    # fallback: clamp aspect, center crop
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        ch, cw = h, int(round(h * ratio[1]))
+    else:
+        cw, ch = w, h
+    left, top = (w - cw) // 2, (h - ch) // 2
+    return img.resize((size, size), _BILINEAR, box=(left, top, left + cw, top + ch))
+
+
+def random_horizontal_flip(img, rng, p=0.5):
+    from PIL import Image
+
+    if rng.random() < p:
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return img
+
+
+def resize_shorter(img, size=256):
+    """Resize so the shorter side == size, keeping aspect (tv Resize(int))."""
+    w, h = img.size
+    if w <= h:
+        nw, nh = size, max(1, int(round(h * size / w)))
+    else:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    return img.resize((nw, nh), _BILINEAR)
+
+
+def center_crop(img, size=224):
+    w, h = img.size
+    left, top = (w - size) // 2, (h - size) // 2
+    return img.crop((left, top, left + size, top + size))
+
+
+def train_transform(size=224):
+    """RandomResizedCrop(size) → flip → uint8 HWC array.
+
+    The returned callable takes ``(img, rng)`` — the loader derives ``rng``
+    per (seed, epoch, sample-index), so augmentations are reproducible no
+    matter how the decode threads are scheduled.
+    """
+
+    def apply(img, rng):
+        img = random_resized_crop(img, rng, size)
+        img = random_horizontal_flip(img, rng)
+        return np.asarray(img, dtype=np.uint8)
+
+    return apply
+
+
+def val_transform(size=224, resize=256):
+    """Resize(resize) → CenterCrop(size) → uint8 HWC array (deterministic;
+    accepts and ignores ``rng`` for signature uniformity)."""
+
+    def apply(img, rng=None):
+        return np.asarray(center_crop(resize_shorter(img, resize), size),
+                          dtype=np.uint8)
+
+    return apply

@@ -18,12 +18,15 @@ def topk_correct_fraction(logits, labels, topk=(1,)):
 
     Returns a tuple of scalar f32 fractions in [0, 1], one per k.
     """
-    maxk = max(topk)
+    num_classes = logits.shape[-1]
+    maxk = min(max(topk), num_classes)  # tiny heads: clamp k (k ≤ classes)
     _, pred = jax.lax.top_k(logits, maxk)  # [batch, maxk]
     correct = pred == labels[:, None]  # [batch, maxk] bool
     fractions = []
     for k in topk:
-        fractions.append(correct[:, :k].any(axis=1).mean(dtype=jnp.float32))
+        fractions.append(
+            correct[:, : min(k, maxk)].any(axis=1).mean(dtype=jnp.float32)
+        )
     return tuple(fractions)
 
 

@@ -40,3 +40,42 @@ def scale_lr_linear(base_lr, global_batch_size):
     """Linear-scaling rule: ``lr0 · global_batch/256``
     (imagenet_ddp_apex.py:161-162)."""
     return base_lr * float(global_batch_size) / 256.0
+
+
+def make_step_decay_schedule(base_lr, steps_per_epoch):
+    """Traced, optax-compatible form of :func:`step_decay_lr`.
+
+    The reference mutates ``optimizer.param_groups`` once per epoch from the
+    host (imagenet_ddp.py:203,374-378); here the LR is a pure function of the
+    optimizer's global step count, evaluated *inside* the compiled train step
+    — no host round-trip, and one compilation covers every epoch.
+    """
+    import jax.numpy as jnp
+
+    def schedule(count):
+        epoch = jnp.asarray(count) // steps_per_epoch
+        return base_lr * jnp.power(0.1, (epoch // 30).astype(jnp.float32))
+
+    return schedule
+
+
+def make_warmup_step_decay_schedule(base_lr, steps_per_epoch):
+    """Traced form of the Apex per-step schedule (:func:`warmup_step_decay_lr`):
+    step decay ×0.1/30 epochs, extra ×0.1 at epoch ≥ 80, 5-epoch linear
+    warmup scaled by global step (imagenet_ddp_apex.py:527-543). The
+    reference's in-epoch ``step`` is 1-based (imagenet_ddp_apex.py:367-369).
+    """
+    import jax.numpy as jnp
+
+    def schedule(count):
+        count = jnp.asarray(count)
+        epoch = count // steps_per_epoch
+        step_1based = count % steps_per_epoch + 1
+        factor = epoch // 30 + jnp.where(epoch >= 80, 1, 0)
+        lr = base_lr * jnp.power(0.1, factor.astype(jnp.float32))
+        warm = lr * (1.0 + step_1based + epoch * steps_per_epoch) / (
+            5.0 * steps_per_epoch
+        )
+        return jnp.where(epoch < 5, warm, lr)
+
+    return schedule

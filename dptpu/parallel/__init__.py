@@ -1,0 +1,27 @@
+"""Parallelism layer: device mesh, distributed init, SPMD sharding helpers.
+
+The TPU-native replacement for the reference's L0-L2 stack (SURVEY.md §1):
+NCCL/Gloo process groups + mp.spawn + DistributedDataParallel become one
+process per host, a global ``jax.sharding.Mesh``, and ``shard_map``-compiled
+collectives over ICI/DCN.
+"""
+
+from dptpu.parallel.dist import initialize_distributed
+from dptpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    replicated_sharding,
+    shard_host_batch,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "initialize_distributed",
+    "make_mesh",
+    "replicated_sharding",
+    "shard_host_batch",
+]

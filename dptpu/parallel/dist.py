@@ -1,0 +1,62 @@
+"""Multi-host rendezvous: the ``init_process_group`` analog.
+
+The reference rendezvouses all ranks through NCCL/Gloo with either a TCP
+master URL (``--dist-url tcp://ip:port``, imagenet_ddp.py:61-63,104-105) or
+``env://`` (MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK, nd_imagenet.py:98-99;
+imagenet_ddp_apex.py:113-125). On TPU the same contract maps onto
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)`` — one *host* process per entry rather than one per chip,
+because chips on a host are driven by a single SPMD program (SURVEY.md §1 L1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+from urllib.parse import urlparse
+
+import jax
+
+from dptpu.config import Config
+
+
+def _resolve_rendezvous(cfg: Config) -> Tuple[Optional[str], int, int]:
+    """Map the reference's (dist_url, world_size, rank) semantics onto
+    (coordinator_address, num_processes, process_id)."""
+    world_size, rank = cfg.world_size, cfg.rank
+    if cfg.dist_url == "env://":
+        # env:// overlay (nd_imagenet.py:98-99,124-125; apex :113-115)
+        if world_size == -1:
+            world_size = int(os.environ.get("WORLD_SIZE", "-1"))
+        if rank == -1:
+            rank = int(os.environ.get("RANK", "-1"))
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = os.environ.get("MASTER_PORT", "23456")
+        coordinator = f"{addr}:{port}"
+    else:
+        u = urlparse(cfg.dist_url)
+        coordinator = f"{u.hostname}:{u.port or 23456}"
+    return coordinator, world_size, rank
+
+
+def initialize_distributed(cfg: Config) -> bool:
+    """Join the multi-host job if the config asks for one.
+
+    Returns True when running multi-process. Safe to call in single-host
+    mode (no-op, like the reference's conditional init, nd_imagenet.py:123).
+    The ``--dist-backend`` flag is accepted but ignored: collectives are
+    always XLA's, compiled onto ICI within a slice and DCN across slices.
+    """
+    coordinator, world_size, rank = _resolve_rendezvous(cfg)
+    if world_size <= 1:
+        return False
+    if rank < 0:
+        raise ValueError(
+            "distributed run needs a rank (--rank or RANK env), got -1"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world_size,
+        process_id=rank,
+    )
+    return True

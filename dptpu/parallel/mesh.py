@@ -1,0 +1,78 @@
+"""Device mesh construction and sharding helpers.
+
+The reference's unit of parallelism is a process pinned to one GPU inside an
+NCCL process group (imagenet_ddp.py:103-127). The TPU-native unit is a named
+mesh axis: every chip on every host joins one global
+``jax.sharding.Mesh`` and parallelism is expressed as sharding
+annotations — XLA compiles the collectives onto ICI (intra-slice) and DCN
+(cross-slice) links.
+
+The default mesh is 1-D over a ``data`` axis (pure data parallelism — the
+reference's only strategy, SURVEY.md §2c), but ``make_mesh`` accepts an
+explicit shape so a ``model`` axis can be opened for tensor/FSDP sharding
+without touching callers (the "don't hard-code a single axis name" guidance,
+SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[dict] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    ``mesh_shape`` maps axis name → size, in axis order; ``-1`` means "all
+    remaining devices". Default: ``{"data": -1}`` — every chip on the data
+    axis, the DDP-equivalent topology.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if mesh_shape is None:
+        mesh_shape = {DATA_AXIS: -1}
+    names = tuple(mesh_shape)
+    sizes = list(mesh_shape.values())
+    n = devices.size
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
+    return Mesh(devices.reshape(sizes), names)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a batch: leading axis split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for params/opt state: replicated on every device (DDP-style)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_host_batch(batch, mesh: Mesh):
+    """Place a host-local numpy batch onto the mesh's data axis.
+
+    The multi-host analog of the reference's per-rank H2D copy
+    (imagenet_ddp.py:258-259): each host holds only its disjoint shard (the
+    DistributedSampler contract, imagenet_ddp.py:178-183), and
+    ``make_array_from_process_local_data`` assembles the logical global batch
+    across hosts without any cross-host data movement.
+    """
+    sharding = data_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )

@@ -1,0 +1,27 @@
+"""Training layer: state, compiled steps, epoch loop, checkpointing.
+
+The TPU-native L6 (SURVEY.md §1): the reference's per-batch Python loop with
+eager H2D copies, backward-hook all-reduce, and ``optimizer.step()``
+(imagenet_ddp.py:239-281) becomes one jitted SPMD ``train_step`` whose
+gradient all-reduce, optimizer update, and metric reduction are a single XLA
+program per step.
+"""
+
+from dptpu.train.checkpoint import load_checkpoint, save_checkpoint
+from dptpu.train.fit import fit
+from dptpu.train.loop import train_one_epoch, validate
+from dptpu.train.state import TrainState, create_train_state, make_optimizer
+from dptpu.train.step import make_eval_step, make_train_step
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "fit",
+    "load_checkpoint",
+    "make_eval_step",
+    "make_optimizer",
+    "make_train_step",
+    "save_checkpoint",
+    "train_one_epoch",
+    "validate",
+]

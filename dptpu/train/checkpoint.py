@@ -1,0 +1,97 @@
+"""Checkpoint save / best-copy / resume with the reference's exact contract.
+
+Payload mirrors the reference's dict {epoch, arch, state_dict, best_acc1,
+optimizer} (imagenet_ddp.py:216-222), carried as a flax-serialized pytree:
+{epoch, arch, params, batch_stats, opt_state, step, best_acc1, and
+training_time when early-stop records it (imagenet_ddp.py:227-234)}.
+Filenames match (``checkpoint.pth.tar`` → copy ``model_best.pth.tar`` when
+best, imagenet_ddp.py:327-330); writes are single-writer (the
+``rank % ngpus == 0`` guard, imagenet_ddp.py:215 — here ``process_index==0``)
+and atomic (tmp + rename), which the reference is not. Unlike torch.load
+there is no ``map_location`` dance: restored arrays are host numpy until the
+next step's sharded ``device_put`` places them (SURVEY.md §3.5 caveat (d):
+we keep a native pytree, not a ``module.``-prefixed state dict).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+import jax
+from flax import serialization
+
+CHECKPOINT_NAME = "checkpoint.pth.tar"
+BEST_NAME = "model_best.pth.tar"
+
+
+def save_checkpoint(
+    state,
+    *,
+    epoch: int,
+    arch: str,
+    best_acc1: float,
+    is_best: bool,
+    directory: str = ".",
+    is_chief: bool = True,
+    training_time: Optional[float] = None,
+    filename: str = CHECKPOINT_NAME,
+) -> Optional[str]:
+    """Serialize state; copy to model_best when ``is_best``. Chief-only."""
+    if not is_chief:
+        return None
+    payload = {
+        "epoch": epoch,
+        "arch": arch,
+        "best_acc1": float(best_acc1),
+        "step": jax.device_get(state.step),
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "training_time": -1.0 if training_time is None else float(training_time),
+    }
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, filename)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    os.replace(tmp, path)
+    if is_best:
+        shutil.copyfile(path, os.path.join(directory, BEST_NAME))
+    return path
+
+
+def load_checkpoint(path: str, state):
+    """Resume: restore state + bookkeeping from a checkpoint file.
+
+    The reference restores start_epoch/best_acc1/model/optimizer
+    (imagenet_ddp.py:138-153). Returns ``(state, meta)`` where meta has
+    ``epoch`` (resume start epoch), ``arch``, ``best_acc1``.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    template = {
+        "epoch": 0,
+        "arch": "",
+        "best_acc1": 0.0,
+        "step": jax.device_get(state.step),
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "training_time": -1.0,
+    }
+    payload = serialization.from_bytes(template, raw)
+    new_state = state.replace(
+        step=payload["step"],
+        params=payload["params"],
+        batch_stats=payload["batch_stats"],
+        opt_state=payload["opt_state"],
+    )
+    meta = {
+        "epoch": int(payload["epoch"]),
+        "arch": payload["arch"],
+        "best_acc1": float(payload["best_acc1"]),
+        "training_time": float(payload["training_time"]),
+    }
+    return new_state, meta

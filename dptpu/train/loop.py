@@ -1,0 +1,132 @@
+"""Epoch orchestration: train → validate → checkpoint-best → early stop.
+
+The reference's L6 (imagenet_ddp.py:200-324) with its exact console surface
+(``Epoch: [e][i/N]  Time … Loss … Acc@1 …`` lines every ``--print-freq``,
+``* Acc@1 … Acc@5 …`` validation summaries) and its control contract
+(checkpoint-best each epoch, ``--desired-acc`` early stop recording
+``training_time``, imagenet_ddp.py:224-236).
+
+One deliberate performance change: metric scalars are NOT pulled from device
+every step — device values are buffered and fetched once per print interval,
+so the hot loop never blocks on a D2H sync (the reference's own optimization,
+imagenet_ddp_apex.py:385-388, applied to all paths; its non-Apex path paid a
+``.item()`` sync per batch, imagenet_ddp.py:267).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+from dptpu.utils.meters import AverageMeter, ProgressMeter, Summary
+
+
+def train_one_epoch(
+    state,
+    train_step: Callable,
+    batches,
+    *,
+    epoch: int,
+    num_batches: int,
+    print_freq: int = 10,
+    verbose: bool = True,
+):
+    """One training epoch. ``batches`` yields device-ready batch dicts.
+
+    Returns ``(state, stats)`` with host-float averages for the epoch.
+    """
+    batch_time = AverageMeter("Time", ":6.3f")
+    data_time = AverageMeter("Data", ":6.3f")
+    losses = AverageMeter("Loss", ":.4e")
+    top1 = AverageMeter("Acc@1", ":6.2f")
+    top5 = AverageMeter("Acc@5", ":6.2f")
+    progress = ProgressMeter(
+        num_batches,
+        [batch_time, data_time, losses, top1, top5],
+        prefix=f"Epoch: [{epoch}]",
+    )
+
+    pending = []  # (device_metrics, n) buffered until the next display
+    last_lr = 0.0
+    end = time.time()
+    i = -1
+    for i, batch in enumerate(batches):
+        data_time.update(time.time() - end)
+        n = int(np.prod(batch["labels"].shape))
+        state, metrics = train_step(state, batch)
+        pending.append((metrics, n))
+        if i % print_freq == 0:
+            # one sync for the whole interval: block on the newest metrics
+            for m, nb in jax.device_get(
+                [(p[0], p[1]) for p in pending]
+            ):
+                losses.update(float(m["loss"]), nb)
+                top1.update(float(m["top1"]), nb)
+                top5.update(float(m["top5"]), nb)
+                last_lr = float(m.get("lr", last_lr))
+            pending.clear()
+            batch_time.update(time.time() - end)
+            if verbose:
+                progress.display(i)
+        else:
+            batch_time.update(time.time() - end)
+        end = time.time()
+    for m, nb in jax.device_get(pending):
+        losses.update(float(m["loss"]), nb)
+        top1.update(float(m["top1"]), nb)
+        top5.update(float(m["top5"]), nb)
+        last_lr = float(m.get("lr", last_lr))
+    stats = {
+        "loss": losses.avg,
+        "top1": top1.avg,
+        "top5": top5.avg,
+        "lr": last_lr,
+        "batch_time": batch_time.avg,
+        "data_time": data_time.avg,
+        "num_batches": i + 1,
+    }
+    return state, stats
+
+
+def validate(
+    state,
+    eval_step: Callable,
+    batches,
+    *,
+    num_batches: int,
+    print_freq: int = 10,
+    verbose: bool = True,
+):
+    """Full validation pass; returns ``{top1, top5, loss, count}`` with exact
+    global aggregation (sharded val + psum — the Apex behavior,
+    imagenet_ddp_apex.py:232-234,457-460 — with a single final sync)."""
+    batch_time = AverageMeter("Time", ":6.3f", Summary.NONE)
+    progress = ProgressMeter(num_batches, [batch_time], prefix="Test: ")
+
+    device_sums = []
+    end = time.time()
+    for i, batch in enumerate(batches):
+        device_sums.append(eval_step(state, batch))
+        batch_time.update(time.time() - end)
+        end = time.time()
+        if verbose and i % print_freq == 0:
+            progress.display(i)
+    totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
+    for sums in jax.device_get(device_sums):
+        for k in totals:
+            totals[k] += float(sums[k])
+    count = max(totals["count"], 1.0)
+    stats = {
+        "top1": 100.0 * totals["correct1"] / count,
+        "top5": 100.0 * totals["correct5"] / count,
+        "loss": totals["loss_sum"] / count,
+        "count": totals["count"],
+        "batch_time": batch_time.avg,
+    }
+    if verbose:
+        # reference summary line (imagenet_ddp.py:321-322)
+        print(" * Acc@1 {top1:.3f} Acc@5 {top5:.3f}".format(**stats))
+    return stats

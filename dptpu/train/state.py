@@ -1,0 +1,74 @@
+"""Train state and the torch-semantics optimizer.
+
+One pytree carries everything the reference splits across mutable objects
+(model params + BN buffers, ``optimizer.param_groups`` state, epoch counter):
+params, batch_stats, optimizer state, and the global step. The checkpoint
+payload (SURVEY.md §3.5) serializes this tree plus bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def make_optimizer(
+    momentum: float = 0.9, weight_decay: float = 1e-4
+) -> optax.GradientTransformation:
+    """SGD direction with torch-exact update semantics (imagenet_ddp.py:133-135),
+    WITHOUT the learning rate.
+
+    torch.optim.SGD applies weight decay *into the gradient before* the
+    momentum accumulation (``g += wd·p``; ``buf = m·buf + g``;
+    ``p -= lr·buf``), and decays **every** parameter — conv/dense kernels,
+    biases, and BN scale/shift alike. This chain reproduces that ordering and
+    yields the un-scaled momentum buffer; the train step multiplies by
+    ``-lr(state.step)`` itself (torch's apply-lr-after-momentum), so the LR
+    schedule is a pure function of the checkpointed global step — restart at
+    ``--start-epoch N`` or resume lands on exactly the reference's epoch-N LR
+    instead of an optimizer-internal count that resets to 0.
+    """
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.trace(decay=momentum, nesterov=False),
+    )
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    tx: optax.GradientTransformation,
+    input_shape=(1, 224, 224, 3),
+    input_dtype=jnp.float32,
+    initial_step: int = 0,
+) -> TrainState:
+    """Initialize params/BN state with a dummy batch and build the state.
+
+    ``initial_step`` seeds the global step for fresh runs that start at a
+    later epoch (``--start-epoch`` without ``--resume``,
+    imagenet_ddp.py:35-36): the LR schedule reads this step.
+    """
+    variables = model.init(rng, jnp.zeros(input_shape, input_dtype), train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.asarray(initial_step, jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )

@@ -1,0 +1,182 @@
+"""Compiled train/eval steps: the reference's hot loop as one XLA program.
+
+One ``train_step`` fuses what the reference does in five eager stages
+(H2D copy → forward → backward with bucketed NCCL all-reduce → SGD step →
+metric ``.item()`` syncs, imagenet_ddp.py:254-281): normalization, forward,
+backward, a single ``lax.pmean`` gradient all-reduce that XLA overlaps with
+the backward computation (replacing c10d's bucketing engine, SURVEY.md §2b),
+the optimizer update, and metric reduction. Parallelism is ``shard_map`` over
+the mesh ``data`` axis with replicated params — the DDP topology. BatchNorm
+runs on the *local* shard (per-replica statistics, DDP's default non-synced
+BN) unless the model was built with ``bn_axis_name="data"`` (the SyncBN
+analog); running stats are pmean'd every step so state stays replicated,
+which matches what every replica would checkpoint/eval after DDP broadcast.
+
+Normalization is fused into the step: batches arrive as raw **uint8** NHWC
+and are converted + normalized on-device with mean/std ×255 — the
+DataPrefetcher's GPU-side normalize (imagenet_ddp_apex.py:329-340), done the
+XLA way (fused into the first conv's input, zero extra HBM round-trips, and
+4× less host→device bandwidth than shipping f32).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+try:  # jax ≥ 0.8 top-level name; experimental path kept as fallback
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from dptpu.ops.loss import cross_entropy_loss
+from dptpu.ops.metrics import topk_correct_fraction
+from dptpu.parallel.mesh import DATA_AXIS
+
+# torchvision Normalize constants (imagenet_ddp.py:163-165)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def normalize_images(images, dtype=jnp.float32):
+    """uint8 [0,255] NHWC → normalized float, on device.
+
+    The ``(x - mean·255) / (std·255)`` form matches the DataPrefetcher
+    (imagenet_ddp_apex.py:333-340); already-float inputs are assumed
+    normalized (the non-Apex ToTensor+Normalize path) and only cast.
+    """
+    if images.dtype == jnp.uint8:
+        mean = jnp.asarray(IMAGENET_MEAN, jnp.float32) * 255.0
+        std = jnp.asarray(IMAGENET_STD, jnp.float32) * 255.0
+        return ((images.astype(jnp.float32) - mean) / std).astype(dtype)
+    return images.astype(dtype)
+
+
+def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
+                    lr_schedule=None):
+    """Build the jitted train step.
+
+    Returns ``step(state, batch) -> (state, metrics)`` where ``batch`` is a
+    dict with ``images`` (uint8/float NHWC) and ``labels`` (int32), and
+    ``metrics`` has scalar f32 ``loss``/``top1``/``top5``/``lr``;
+    loss/top1/top5 are already cross-replica-averaged (the reference's
+    reduce_tensor, imagenet_ddp_apex.py:562-566, folded into the step).
+
+    ``lr_schedule`` maps the global step count → learning rate (see
+    dptpu.ops.schedules); it is applied here, after the optimizer's
+    momentum/weight-decay chain, reproducing torch SGD's ``p -= lr·buf``.
+    Defaults to constant 0.1 (the reference's base LR) for schedule-less
+    callers.
+    """
+
+    if lr_schedule is None:
+        lr_schedule = lambda count: 0.1  # noqa: E731
+    axis_size = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+
+    def step(state, batch):
+        images = normalize_images(batch["images"], compute_dtype)
+        labels = batch["labels"]
+
+        def loss_fn(params):
+            out, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            local_loss = cross_entropy_loss(out, labels)
+            # Divide the shard-local mean by the axis size: under shard_map,
+            # params enter replicated (in_spec P()), and jax's VMA semantics
+            # make the gradient transpose insert the cross-shard psum
+            # automatically — that psum IS the DDP all-reduce (XLA schedules
+            # it overlapped with backward). psum(local_mean/axis_size) is
+            # exactly the global-batch-mean gradient; an explicit pmean here
+            # would double-count by axis_size.
+            return local_loss / axis_size, (local_loss, out, mutated["batch_stats"])
+
+        (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
+        if mesh is not None:
+            # running BN stats + reported metrics: explicit cross-replica mean
+            # (the reference's reduce_tensor, imagenet_ddp_apex.py:562-566)
+            new_stats, loss, top1, top5 = lax.pmean(
+                (new_stats, loss, top1, top5), DATA_AXIS
+            )
+        direction, new_opt = state.tx.update(grads, state.opt_state, state.params)
+        lr = lr_schedule(state.step)
+        updates = jax.tree_util.tree_map(lambda u: -lr * u, direction)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        metrics = {
+            "loss": loss,
+            "top1": top1 * 100.0,
+            "top5": top5 * 100.0,
+            "lr": jnp.asarray(lr, jnp.float32),
+        }
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+def make_eval_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32):
+    """Build the jitted eval step.
+
+    Returns ``eval_step(state, batch) -> sums`` with ``loss_sum``,
+    ``correct1``, ``correct5``, ``count`` summed over the GLOBAL batch
+    (psum over the data axis) — exact aggregate accuracy, the sharded-val +
+    all-reduce behavior of the Apex path (imagenet_ddp_apex.py:232-234,
+    457-460), but without its per-step host sync. An optional f32 ``mask``
+    in the batch (1.0 = real sample) makes padded remainder batches exact.
+    """
+
+    def step(state, batch):
+        images = normalize_images(batch["images"], compute_dtype)
+        labels = batch["labels"]
+        mask = batch.get("mask", jnp.ones(labels.shape, jnp.float32))
+        logits = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        ).astype(jnp.float32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        _, pred = lax.top_k(logits, min(5, logits.shape[-1]))
+        hit = pred == labels[:, None]
+        sums = {
+            "loss_sum": (per_ex * mask).sum(),
+            "correct1": (hit[:, :1].any(axis=1) * mask).sum(),
+            "correct5": (hit.any(axis=1) * mask).sum(),
+            "count": mask.sum(),
+        }
+        if mesh is not None:
+            sums = lax.psum(sums, DATA_AXIS)
+        return sums
+
+    if mesh is None:
+        return jax.jit(step)
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)

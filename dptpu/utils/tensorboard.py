@@ -1,0 +1,146 @@
+"""Zero-dependency TensorBoard event writer.
+
+The reference logs 11 scalars per epoch from rank 0 into a run-config-named
+directory via tensorboardX (imagenet_ddp_apex.py:152-159,280-290). dptpu
+writes the same wire format — TFRecord-framed Event protobufs with masked
+CRC32C — by hand, so metrics open in stock TensorBoard with no tensorflow /
+tensorboardX / torch dependency anywhere in the framework.
+
+Format references (public): TFRecord framing = {uint64 len, uint32
+masked_crc32c(len), bytes, uint32 masked_crc32c(bytes)}; Event proto fields
+{1: wall_time double, 2: step int64, 3: file_version string, 5: Summary};
+Summary.Value fields {1: tag string, 2: simple_value float}.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+# ---------------------------------------------------------------- crc32c ----
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- protobuf -----
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _field_double(num: int, value: float) -> bytes:
+    return _varint(num << 3 | 1) + struct.pack("<d", value)
+
+
+def _field_float(num: int, value: float) -> bytes:
+    return _varint(num << 3 | 5) + struct.pack("<f", value)
+
+
+def _field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3 | 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _event(wall_time: float, step: Optional[int] = None,
+           file_version: Optional[str] = None,
+           summary: Optional[bytes] = None) -> bytes:
+    msg = _field_double(1, wall_time)
+    if step is not None:
+        msg += _field_varint(2, step)
+    if file_version is not None:
+        msg += _field_bytes(3, file_version.encode())
+    if summary is not None:
+        msg += _field_bytes(5, summary)
+    return msg
+
+
+def _scalar_summary(tag: str, value: float) -> bytes:
+    val = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    return _field_bytes(1, val)
+
+
+# --------------------------------------------------------------- writer -----
+
+
+class SummaryWriter:
+    """tensorboardX-compatible surface: ``add_scalar``, ``log_dir``, ``close``.
+
+    ``comment`` builds the run directory exactly like the reference's
+    ``runs/<datetime>_<host><comment>`` naming (imagenet_ddp_apex.py:155-159).
+    """
+
+    def __init__(self, log_dir: Optional[str] = None, comment: str = ""):
+        if log_dir is None:
+            stamp = time.strftime("%b%d_%H-%M-%S")
+            log_dir = os.path.join(
+                "runs", f"{stamp}_{socket.gethostname()}{comment}"
+            )
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self._file = open(os.path.join(log_dir, fname), "ab")
+        self._write_record(_event(time.time(), file_version="brain.Event:2"))
+
+    def _write_record(self, data: bytes):
+        header = struct.pack("<Q", len(data))
+        self._file.write(header)
+        self._file.write(struct.pack("<I", _masked_crc(header)))
+        self._file.write(data)
+        self._file.write(struct.pack("<I", _masked_crc(data)))
+
+    def add_scalar(self, tag: str, value, global_step: int = 0):
+        self._write_record(
+            _event(time.time(), step=int(global_step),
+                   summary=_scalar_summary(tag, float(value)))
+        )
+        self._file.flush()
+
+    def flush(self):
+        self._file.flush()
+
+    def close(self):
+        if not self._file.closed:
+            self._file.flush()
+            self._file.close()

@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""Mixed-precision distributed ImageNet training on TPU — the
+``imagenet_ddp_apex.py`` entry point (reference:
+/root/reference/imagenet_ddp_apex.py), CLI-compatible.
+
+Apex AMP becomes the native bf16 compute policy: any ``--opt-level`` ≥ O1
+runs the model in bf16 with fp32 BatchNorm and fp32 master params —
+``--loss-scale`` is accepted and unused because bf16 keeps fp32's exponent
+range (no underflow to scale away). ``--sync-bn`` turns on cross-replica
+BatchNorm statistics via a pmean inside the compiled step. The linear LR
+scaling rule (lr·global_batch/256), 5-epoch warmup, and the extra ×0.1 decay
+at epoch ≥ 80 match the reference schedule exactly
+(imagenet_ddp_apex.py:161-162,527-543). Batch size is per-device, as in the
+reference (:63-67). Launch: one process per host with WORLD_SIZE/RANK/
+MASTER_ADDR env vars (env:// rendezvous), not one per chip.
+"""
+
+from dptpu.config import parse_config
+from dptpu.train import fit
+
+
+def main():
+    cfg = parse_config(variant="apex").replace(dist_url="env://")
+    fit(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""Single-device / fallback-everything ImageNet training on TPU — the
+``nd_imagenet.py`` entry point (reference: /root/reference/nd_imagenet.py),
+CLI-compatible.
+
+The reference's 5-way device-placement ladder (CPU → pinned GPU → DDP →
+DataParallel, nd_imagenet.py:140-169) collapses on TPU: ``--gpu N`` pins one
+local chip, otherwise all visible devices join a mesh; a CPU-only machine
+just runs the same program on the CPU backend. ``--seed`` gives end-to-end
+reproducibility (XLA is deterministic by default — no cudnn.deterministic
+trade-off, nd_imagenet.py:84-92).
+"""
+
+from dptpu.config import parse_config
+from dptpu.train import fit
+
+
+def main():
+    cfg = parse_config(variant="nd")
+    fit(cfg)
+
+
+if __name__ == "__main__":
+    main()

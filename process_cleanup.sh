@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Kill orphaned training processes across the host list after a crashed
+# multi-host run — the reference's out-of-band cleanup
+# (/root/reference/process_cleanup.sh), with its bug fixed: the reference ran
+# `ssh -p $node && pkill ...`, which passes the hostname as a *port* and
+# pkills locally. This version actually executes pkill on each remote host,
+# and targets only dptpu trainers instead of every python on the machine.
+#
+# Usage: HOSTLIST="host1 host2 ..." ./process_cleanup.sh
+set -u
+HOSTLIST="${HOSTLIST:-hal01 hal02 hal03 hal04}"
+PATTERN="${PATTERN:-imagenet_ddp|nd_imagenet|dptpu}"
+for node in $HOSTLIST; do
+    echo "cleaning $node"
+    ssh -o BatchMode=yes -o ConnectTimeout=5 "$node" \
+        "pkill -9 -f '$PATTERN'" && echo "  killed on $node" \
+        || echo "  nothing to kill (or ssh failed) on $node"
+done

@@ -1,28 +1,33 @@
 """Test harness: fake TPU pod on CPU.
 
 Multi-chip hardware is not available in CI, so every test runs on a virtual
-8-device CPU mesh — the standard JAX fake-cluster trick (SURVEY.md §4): the
-CPU platform is forced and split into 8 devices BEFORE jax initializes.
-This stands in for a single-host TPU slice; the driver separately dry-runs
-the multi-chip path via __graft_entry__.dryrun_multichip.
+8-device CPU mesh — the standard JAX fake-cluster trick (SURVEY.md §4). The
+CPU platform must be forced via ``jax.config.update``, not env vars: this
+image's sitecustomize imports jax at interpreter startup (to register the
+axon TPU plugin), so ``JAX_PLATFORMS`` is already latched by the time test
+code runs. ``XLA_FLAGS`` is still honored because the CPU PJRT client is
+created lazily, at the first backend use — which is after this conftest.
+The driver separately dry-runs the real multi-chip path via
+``__graft_entry__.dryrun_multichip``.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 fake devices, got {len(devices)}"
     return devices[:8]

@@ -1,0 +1,160 @@
+"""Input-pipeline tests: ImageFolder semantics, sharding, collation, prefetch."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dptpu.data import (
+    DataLoader,
+    DevicePrefetcher,
+    ImageFolderDataset,
+    ShardedSampler,
+    SyntheticDataset,
+    center_crop,
+    random_resized_crop,
+    resize_shorter,
+    train_transform,
+    val_transform,
+)
+@pytest.fixture
+def image_folder(tmp_path):
+    # 3 classes × 5 images, deliberately created out of sorted order
+    rng = np.random.RandomState(0)
+    for cls in ["n02", "n01", "n03"]:
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(5):
+            arr = rng.randint(0, 256, (40, 52, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i}.png")
+    return str(tmp_path / "train")
+
+
+def test_image_folder_semantics(image_folder):
+    ds = ImageFolderDataset(image_folder)
+    # sorted class names → indices (torchvision contract)
+    assert ds.classes == ["n01", "n02", "n03"]
+    assert ds.class_to_idx["n01"] == 0
+    assert len(ds) == 15
+    img, label = ds[0]
+    assert img.shape == (40, 52, 3) and img.dtype == np.uint8
+    assert label == 0
+
+
+def test_sampler_disjoint_cover_and_reshuffle():
+    s = [ShardedSampler(103, num_shards=4, shard_index=i, seed=7)
+         for i in range(4)]
+    all_idx = np.concatenate([x.indices(epoch=0) for x in s])
+    # ceil(103/4)=26 per shard; padded total 104 covers every example
+    assert all(len(x) == 26 for x in s)
+    assert set(all_idx.tolist()) == set(range(103))
+    # disjoint before padding: only one duplicated example (104-103)
+    vals, counts = np.unique(all_idx, return_counts=True)
+    assert (counts > 1).sum() == 1
+    # set_epoch analog: different permutation, same cover
+    e1 = np.concatenate([x.indices(epoch=1) for x in s])
+    assert not np.array_equal(all_idx, e1)
+    assert set(e1.tolist()) == set(range(103))
+
+
+def test_sampler_no_shuffle_drop_last():
+    s = ShardedSampler(10, num_shards=3, shard_index=2, shuffle=False,
+                       drop_last=True)
+    assert len(s) == 3
+    np.testing.assert_array_equal(s.indices(0), [2, 5, 8])
+
+
+def test_loader_batches_and_padded_tail(image_folder):
+    ds = ImageFolderDataset(image_folder)
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 4  # ceil(15/4)
+    for b in batches[:-1]:
+        assert b["images"].shape == (4, 40, 52, 3)
+        assert b["images"].dtype == np.uint8
+        assert b["labels"].dtype == np.int32
+        assert "mask" not in b
+    tail = batches[-1]
+    assert tail["mask"].tolist() == [1.0, 1.0, 1.0, 0.0]
+    # padding repeats sample 0 of the batch
+    np.testing.assert_array_equal(tail["images"][3], tail["images"][0])
+    loader.close()
+
+
+def test_loader_short_tail_without_padding(image_folder):
+    ds = ImageFolderDataset(image_folder)
+    loader = DataLoader(ds, batch_size=4, pad_final=False)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 4
+    assert batches[-1]["images"].shape[0] == 3  # 15 % 4, unpadded
+    assert "mask" not in batches[-1]
+    loader.close()
+
+
+def test_loader_augmentation_deterministic_across_runs(image_folder):
+    # per-(seed, epoch, index) RNG: identical batches regardless of thread
+    # scheduling; different epoch → different augmentation
+    from dptpu.data import train_transform
+
+    ds = ImageFolderDataset(image_folder, train_transform(32))
+    a = DataLoader(ds, batch_size=4, num_workers=4, seed=5)
+    b = DataLoader(ds, batch_size=4, num_workers=1, seed=5)
+    ba = list(a.epoch(0))
+    bb = list(b.epoch(0))
+    for x, y in zip(ba, bb):
+        np.testing.assert_array_equal(x["images"], y["images"])
+    e1 = list(a.epoch(1))
+    assert not all(
+        np.array_equal(x["images"], y["images"]) for x, y in zip(ba, e1)
+    )
+    a.close()
+    b.close()
+
+
+def test_loader_drop_last(image_folder):
+    ds = ImageFolderDataset(image_folder)
+    loader = DataLoader(ds, batch_size=4, drop_last=True)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 3
+    assert all("mask" not in b for b in batches)
+    loader.close()
+
+
+def test_transforms_shapes_and_determinism():
+    img = Image.fromarray(
+        np.random.RandomState(1).randint(0, 256, (300, 400, 3), dtype=np.uint8)
+    )
+    # val path: deterministic, torchvision-exact geometry
+    assert resize_shorter(img, 256).size == (341, 256)  # w>h keeps aspect
+    assert center_crop(resize_shorter(img, 256), 224).size == (224, 224)
+    out = val_transform()(img)
+    assert out.shape == (224, 224, 3) and out.dtype == np.uint8
+    # train path: correct size; same seed → same crop
+    t1 = random_resized_crop(img, np.random.default_rng(3), 224)
+    t2 = random_resized_crop(img, np.random.default_rng(3), 224)
+    assert t1.size == (224, 224)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    tt = train_transform(96)
+    assert tt(img, np.random.default_rng(0)).shape == (96, 96, 3)
+
+
+def test_synthetic_dataset_deterministic():
+    ds = SyntheticDataset(num_samples=8, image_size=32, num_classes=10)
+    img_a, lab_a = ds[3]
+    img_b, lab_b = ds[3]
+    np.testing.assert_array_equal(img_a, img_b)
+    assert lab_a == lab_b and 0 <= lab_a < 10
+    assert img_a.shape == (32, 32, 3)
+
+
+def test_device_prefetcher_preserves_order():
+    ds = SyntheticDataset(num_samples=12, image_size=8, num_classes=4)
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    direct = [b["labels"].copy() for b in loader.epoch(0)]
+    fetched = [
+        np.asarray(b["labels"])
+        for b in DevicePrefetcher(loader.epoch(0))
+    ]
+    assert len(fetched) == len(direct) == 3
+    for a, b in zip(direct, fetched):
+        np.testing.assert_array_equal(a, b)
+    loader.close()

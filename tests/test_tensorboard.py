@@ -1,0 +1,49 @@
+"""The hand-rolled event writer must be readable by stock TensorBoard."""
+
+import glob
+import os
+
+from dptpu.utils.tensorboard import SummaryWriter, _crc32c
+
+
+def test_crc32c_known_vectors():
+    # public CRC-32C (Castagnoli) test vectors
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(b"a") == 0xC1D04330
+
+
+def test_tensorboard_reads_our_events(tmp_path):
+    w = SummaryWriter(log_dir=str(tmp_path / "run1"))
+    scalars = {
+        "Loss/train": [(1, 6.9), (2, 5.5)],
+        "Top1/val": [(1, 12.5), (2, 31.25)],
+        "Lr": [(1, 0.1)],
+    }
+    for tag, points in scalars.items():
+        for step, val in points:
+            w.add_scalar(tag, val, step)
+    w.close()
+
+    from tensorboard.backend.event_processing import event_accumulator
+
+    acc = event_accumulator.EventAccumulator(str(tmp_path / "run1"))
+    acc.Reload()
+    assert set(acc.Tags()["scalars"]) == set(scalars)
+    for tag, points in scalars.items():
+        got = [(e.step, round(e.value, 5)) for e in acc.Scalars(tag)]
+        assert got == [(s, round(v, 5)) for s, v in points]
+
+
+def test_run_dir_naming_comment():
+    w = SummaryWriter(log_dir=None, comment="_resnet50_gpux4_b224_cpu4_optO2")
+    try:
+        assert "runs" in w.log_dir
+        assert w.log_dir.endswith("_resnet50_gpux4_b224_cpu4_optO2")
+        assert glob.glob(os.path.join(w.log_dir, "events.out.tfevents.*"))
+    finally:
+        w.close()
+        # clean the cwd-relative runs dir created by this test
+        import shutil
+
+        shutil.rmtree("runs", ignore_errors=True)
