@@ -30,6 +30,7 @@ class AlexNet(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_axis_name: Any = None  # no BN in AlexNet; accepted for API uniformity
+    bn_dtype: Any = None  # likewise accepted for API uniformity
 
     def _conv(self, features, kernel, stride, padding, in_features, name):
         return nn.Conv(

@@ -40,6 +40,9 @@ class VGG(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     bn_axis_name: Optional[str] = None
+    # BN I/O dtype; None → follow ``dtype``. Statistics stay fp32 (flax
+    # promotes reductions), matching keep_batchnorm_fp32 where it matters.
+    bn_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -65,7 +68,7 @@ class VGG(nn.Module):
                     use_running_average=not train,
                     momentum=0.9,
                     epsilon=1e-5,
-                    dtype=jnp.float32,
+                    dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
                     param_dtype=jnp.float32,
                     axis_name=self.bn_axis_name,
                     name=f"features_{layer_idx}",

@@ -117,12 +117,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     steps_per_epoch = max(len(train_loader), 1)
 
     compute_dtype = jnp.bfloat16 if derived.use_bf16 else jnp.float32
+    # BN activations follow the compute dtype (statistics always accumulate
+    # in fp32 inside flax) unless --keep-batchnorm-fp32 True pins BN I/O to
+    # fp32 — the Apex flag's strictest reading (imagenet_ddp_apex.py:93).
+    keep_bn_fp32 = str(cfg.keep_batchnorm_fp32).lower() in ("true", "1")
     model = create_model(
         cfg.arch,
         pretrained=cfg.pretrained,
         num_classes=num_classes,
         dtype=compute_dtype,
         bn_axis_name="data" if (derived.sync_bn and mesh is not None) else None,
+        bn_dtype=jnp.float32 if keep_bn_fp32 else None,
     )
     if cfg.variant == "apex":
         schedule = make_warmup_step_decay_schedule(derived.scaled_lr, steps_per_epoch)

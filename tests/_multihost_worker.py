@@ -1,0 +1,96 @@
+"""Worker process for the 2-host distributed integration test.
+
+Each instance is one "host" (JAX process) with 2 fake CPU chips; together
+they form a 4-chip pod. Exercises the real multi-host stack: gRPC rendezvous
+through ``initialize_distributed`` (the init_process_group analog), a global
+mesh, per-host disjoint batches assembled with
+``make_array_from_process_local_data``, pmean'd DDP steps, and the
+single-writer checkpoint guard.
+
+Usage: python _multihost_worker.py <port> <rank> <outdir>
+"""
+
+import os
+import sys
+
+
+def main():
+    port, rank, outdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from dptpu.config import Config, derive
+    from dptpu.parallel import initialize_distributed, make_mesh, shard_host_batch
+    from dptpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+        save_checkpoint,
+    )
+    from flax import linen as nn
+
+    cfg = Config(
+        data="unused",
+        dist_url=f"tcp://127.0.0.1:{port}",
+        world_size=2,
+        rank=rank,
+    )
+    assert initialize_distributed(cfg)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    derived = derive(
+        cfg,
+        local_device_count=jax.local_device_count(),
+        num_processes=jax.process_count(),
+        process_index=jax.process_index(),
+    )
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.relu(x).mean(axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    mesh = make_mesh()
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), Tiny(), tx, input_shape=(1, 8, 8, 3)
+    )
+    step = make_train_step(mesh, lr_schedule=lambda c: 0.1)
+
+    # per-host disjoint data (what the ShardedSampler would produce)
+    rng = np.random.RandomState(100 + rank)
+    losses = []
+    for i in range(3):
+        host_batch = {
+            "images": rng.randint(0, 256, (8, 8, 8, 3)).astype(np.uint8),
+            "labels": rng.randint(0, 4, (8,)).astype(np.int32),
+        }
+        state, metrics = step(state, shard_host_batch(host_batch, mesh))
+        losses.append(float(metrics["loss"]))
+
+    save_checkpoint(
+        state,
+        epoch=1,
+        arch="tiny",
+        best_acc1=0.0,
+        is_best=False,
+        directory=outdir,
+        is_chief=derived.is_chief,
+        filename=f"ckpt_rank{rank}.pth.tar",
+    )
+    print(f"RANK{rank} LOSSES {' '.join(f'{l:.6f}' for l in losses)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

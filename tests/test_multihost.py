@@ -1,0 +1,62 @@
+"""2-process / 4-fake-chip distributed integration (SURVEY.md §4 multi-host tier).
+
+Spawns two real OS processes that rendezvous through the JAX coordination
+service (the reference's init_process_group network boundary,
+imagenet_ddp.py:104-105), train a shared model on disjoint per-host data,
+and must agree bit-for-bit on the pmean'd loss — the cross-host DDP
+invariant. Also checks the single-writer checkpoint guard (rank-0 writes,
+rank-1 does not; imagenet_ddp.py:215).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_training_agrees(tmp_path):
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own 2-device split
+    # python adds the script's dir (tests/), not the repo root, to sys.path
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(rank), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(worker)),
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+
+    losses = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RANK"):
+                rank = int(line.split()[0][4:])
+                losses[rank] = line.split()[2:]
+    assert set(losses) == {0, 1}
+    # DDP invariant: pmean'd metrics identical across hosts, every step
+    assert losses[0] == losses[1]
+    # single-writer guard: only rank 0 checkpoints
+    assert (tmp_path / "ckpt_rank0.pth.tar").exists()
+    assert not (tmp_path / "ckpt_rank1.pth.tar").exists()
