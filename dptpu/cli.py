@@ -1,0 +1,26 @@
+"""Console entry points — shared by the repo-root reference-parity scripts
+and the installed ``dptpu-*`` commands (pyproject [project.scripts])."""
+
+from dptpu.config import parse_config
+from dptpu.train import fit
+
+
+def main_ddp(argv=None):
+    """imagenet_ddp.py: multi-host data-parallel training."""
+    cfg = parse_config(argv, variant="ddp")
+    result = fit(cfg)
+    if result.get("early_stopped"):
+        print(f"early stop: training_time {result['training_time']:.1f}s")
+    return result
+
+
+def main_nd(argv=None):
+    """nd_imagenet.py: single-device / fallback-everything training."""
+    cfg = parse_config(argv, variant="nd")
+    return fit(cfg)
+
+
+def main_apex(argv=None):
+    """imagenet_ddp_apex.py: bf16 mixed-precision training (env:// rendezvous)."""
+    cfg = parse_config(argv, variant="apex").replace(dist_url="env://")
+    return fit(cfg)

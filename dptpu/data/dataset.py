@@ -54,18 +54,40 @@ class ImageFolderDataset:
 
     def get(self, index: int, rng: Optional[np.random.Generator] = None):
         """Load + transform one sample; ``rng`` drives any augmentation
-        randomness (per-item, loader-provided — see DataLoader)."""
+        randomness (per-item, loader-provided — see DataLoader).
+
+        JPEGs with a box-sampling transform take the native fast path
+        (libjpeg scaled decode + fused crop-resize, dptpu/native) when the
+        in-tree C library is buildable; everything else decodes via PIL.
+        Both paths consume the same sampled crop box, so the choice of
+        decoder never changes which pixels a seeded run selects.
+        """
+        path, label = self.samples[index]
+        if rng is None:
+            rng = np.random.default_rng(index)
+        if self.transform is not None and hasattr(self.transform, "sample") \
+                and path.lower().endswith((".jpg", ".jpeg")):
+            from dptpu.data import native_image
+
+            if native_image.available():
+                with open(path, "rb") as f:
+                    data = f.read()
+                dims = native_image.jpeg_dims(data)
+                if dims is not None:
+                    box, flip = self.transform.sample(dims[0], dims[1], rng)
+                    out = native_image.decode_crop_resize(
+                        data, box, self.transform.size, flip
+                    )
+                    if out is not None:
+                        return out, label
         from PIL import Image
 
-        path, label = self.samples[index]
         with Image.open(path) as img:
             img = img.convert("RGB")
             if self.transform is None:
                 out = np.asarray(img)
             else:
-                out = self.transform(
-                    img, rng if rng is not None else np.random.default_rng(index)
-                )
+                out = self.transform(img, rng)
         return out, label
 
     def __getitem__(self, index: int):

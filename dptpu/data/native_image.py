@@ -1,0 +1,48 @@
+"""ctypes bindings for the native image ops (dptpu/native/src/image_ops.cpp).
+
+``decode_crop_resize`` fuses JPEG decode (at the lowest sufficient libjpeg
+scale), crop, bilinear resize, and flip into one C call that releases the
+GIL — the data pipeline's per-item hot path. ``available()`` gates use;
+non-JPEG inputs and missing-toolchain environments fall back to PIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dptpu.native import load_library
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def jpeg_dims(data: bytes) -> Optional[Tuple[int, int]]:
+    """(width, height) from the JPEG header, or None if not decodable."""
+    lib = load_library()
+    if lib is None:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    if lib.dptpu_jpeg_dims(data, len(data), ctypes.byref(w), ctypes.byref(h)):
+        return None
+    return w.value, h.value
+
+
+def decode_crop_resize(data: bytes, box, out_size: int,
+                       flip: bool) -> Optional[np.ndarray]:
+    """Decode + crop ``box`` (left, top, w, h in full-res coords) + resize to
+    ``out_size``² RGB (+flip). Returns uint8 HWC array or None on failure."""
+    lib = load_library()
+    if lib is None:
+        return None
+    out = np.empty((out_size, out_size, 3), np.uint8)
+    left, top, cw, ch = (int(v) for v in box)
+    rc = lib.dptpu_jpeg_decode_crop_resize(
+        data, len(data), left, top, cw, ch, out_size, int(flip),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out if rc == 0 else None

@@ -6,9 +6,14 @@ Normalize are deliberately ABSENT: like the Apex fast path ("Too slow" on
 CPU, imagenet_ddp_apex.py:215-226), output stays uint8 HWC and normalization
 happens on-device inside the compiled step (dptpu.train.step.normalize_images).
 
-All randomness flows through an explicit ``numpy.random.Generator`` so a
-seeded run is reproducible end-to-end (the ``--seed`` contract,
-nd_imagenet.py:68-69,84-92) without any process-global RNG state.
+Crop-geometry *sampling* (the randomness) is separated from *application*
+(the pixels): ``TrainTransform.sample`` draws the torchvision
+RandomResizedCrop box + flip from an explicit ``numpy.random.Generator``, and
+either the PIL path here or the native C++ decoder
+(dptpu/native, libjpeg decode + fused bilinear crop-resize) applies it.
+Both appliers consume identical boxes, so a seeded run selects identical
+crops regardless of which backend decodes (the ``--seed`` contract,
+nd_imagenet.py:68-69,84-92).
 """
 
 from __future__ import annotations
@@ -20,34 +25,99 @@ import numpy as np
 _BILINEAR = 2  # PIL.Image.BILINEAR
 
 
-def random_resized_crop(img, rng, size=224, scale=(0.08, 1.0),
-                        ratio=(3.0 / 4.0, 4.0 / 3.0)):
-    """torchvision RandomResizedCrop: area ~ U(scale)·A, log-uniform aspect,
-    10 attempts, then the aspect-clamped center-crop fallback."""
-    w, h = img.size
-    area = w * h
+def sample_rrc_box(width, height, rng, scale=(0.08, 1.0),
+                   ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """torchvision RandomResizedCrop geometry: area ~ U(scale)·A, log-uniform
+    aspect, 10 attempts, then the aspect-clamped center-crop fallback.
+    Returns ``(left, top, crop_w, crop_h)`` in original-image coordinates."""
+    area = width * height
     log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
     for _ in range(10):
         target_area = area * rng.uniform(scale[0], scale[1])
         aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
         cw = int(round(math.sqrt(target_area * aspect)))
         ch = int(round(math.sqrt(target_area / aspect)))
-        if 0 < cw <= w and 0 < ch <= h:
-            left = int(rng.integers(0, w - cw + 1))
-            top = int(rng.integers(0, h - ch + 1))
-            return img.resize(
-                (size, size), _BILINEAR, box=(left, top, left + cw, top + ch)
-            )
+        if 0 < cw <= width and 0 < ch <= height:
+            left = int(rng.integers(0, width - cw + 1))
+            top = int(rng.integers(0, height - ch + 1))
+            return left, top, cw, ch
     # fallback: clamp aspect, center crop
-    in_ratio = w / h
+    in_ratio = width / height
     if in_ratio < ratio[0]:
-        cw, ch = w, int(round(w / ratio[0]))
+        cw, ch = width, int(round(width / ratio[0]))
     elif in_ratio > ratio[1]:
-        ch, cw = h, int(round(h * ratio[1]))
+        ch, cw = height, int(round(height * ratio[1]))
     else:
-        cw, ch = w, h
-    left, top = (w - cw) // 2, (h - ch) // 2
-    return img.resize((size, size), _BILINEAR, box=(left, top, left + cw, top + ch))
+        cw, ch = width, height
+    return (width - cw) // 2, (height - ch) // 2, cw, ch
+
+
+def center_fit_box(width, height, size=224, resize=256):
+    """Resize(resize)+CenterCrop(size) as ONE crop box in original
+    coordinates: scale s = resize/min(w,h); the size×size center crop of the
+    scaled image corresponds to a centered (size/s)×(size/s) source box."""
+    crop = min(width, height) * size / float(resize)
+    cw = ch = int(round(crop))
+    return (width - cw) // 2, (height - ch) // 2, cw, ch
+
+
+class TrainTransform:
+    """RandomResizedCrop(size) → flip → uint8 HWC array (PIL applier)."""
+
+    def __init__(self, size=224, scale=(0.08, 1.0),
+                 ratio=(3.0 / 4.0, 4.0 / 3.0), flip_prob=0.5):
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.flip_prob = flip_prob
+
+    def sample(self, width, height, rng):
+        """Draw (box, flip) for one item — shared by PIL and native paths."""
+        box = sample_rrc_box(width, height, rng, self.scale, self.ratio)
+        flip = bool(rng.random() < self.flip_prob)
+        return box, flip
+
+    def __call__(self, img, rng):
+        from PIL import Image
+
+        (left, top, cw, ch), flip = self.sample(*img.size, rng)
+        out = img.resize(
+            (self.size, self.size), _BILINEAR,
+            box=(left, top, left + cw, top + ch),
+        )
+        if flip:
+            out = out.transpose(Image.FLIP_LEFT_RIGHT)
+        return np.asarray(out, dtype=np.uint8)
+
+
+class ValTransform:
+    """Resize(resize) → CenterCrop(size) → uint8 HWC array (PIL applier;
+    accepts and ignores ``rng``)."""
+
+    def __init__(self, size=224, resize=256):
+        self.size = size
+        self.resize = resize
+
+    def sample(self, width, height, rng=None):
+        return center_fit_box(width, height, self.size, self.resize), False
+
+    def __call__(self, img, rng=None):
+        (left, top, cw, ch), _ = self.sample(*img.size)
+        out = img.resize(
+            (self.size, self.size), _BILINEAR,
+            box=(left, top, left + cw, top + ch),
+        )
+        return np.asarray(out, dtype=np.uint8)
+
+
+# legacy functional forms (kept for tests / direct use) -----------------------
+
+
+def random_resized_crop(img, rng, size=224, scale=(0.08, 1.0),
+                        ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    left, top, cw, ch = sample_rrc_box(*img.size, rng, scale, ratio)
+    return img.resize((size, size), _BILINEAR,
+                      box=(left, top, left + cw, top + ch))
 
 
 def random_horizontal_flip(img, rng, p=0.5):
@@ -75,27 +145,10 @@ def center_crop(img, size=224):
 
 
 def train_transform(size=224):
-    """RandomResizedCrop(size) → flip → uint8 HWC array.
-
-    The returned callable takes ``(img, rng)`` — the loader derives ``rng``
-    per (seed, epoch, sample-index), so augmentations are reproducible no
-    matter how the decode threads are scheduled.
-    """
-
-    def apply(img, rng):
-        img = random_resized_crop(img, rng, size)
-        img = random_horizontal_flip(img, rng)
-        return np.asarray(img, dtype=np.uint8)
-
-    return apply
+    """Factory kept for API stability: returns a TrainTransform."""
+    return TrainTransform(size)
 
 
 def val_transform(size=224, resize=256):
-    """Resize(resize) → CenterCrop(size) → uint8 HWC array (deterministic;
-    accepts and ignores ``rng`` for signature uniformity)."""
-
-    def apply(img, rng=None):
-        return np.asarray(center_crop(resize_shorter(img, resize), size),
-                          dtype=np.uint8)
-
-    return apply
+    """Factory kept for API stability: returns a ValTransform."""
+    return ValTransform(size, resize)

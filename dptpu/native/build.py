@@ -1,0 +1,64 @@
+"""Build + load the native image-ops shared library.
+
+Compiled lazily with g++ (no pybind11 — plain C ABI via ctypes), cached
+under ``_build/`` keyed by source mtime. Thread-safe; failure is cached so a
+missing toolchain costs one attempt per process and the pipeline silently
+stays on PIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "image_ops.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB = os.path.join(_BUILD_DIR, "libdptpu_image.so")
+
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_attempted = False
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _LIB + ".tmp", _SRC, "-ljpeg",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    os.replace(_LIB + ".tmp", _LIB)
+    return True
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Return the ctypes handle to the native lib, building if needed."""
+    global _cached, _attempted
+    with _lock:
+        if _cached is not None or _attempted:
+            return _cached
+        _attempted = True
+        if not _compile():
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.dptpu_jpeg_dims.restype = ctypes.c_int
+        lib.dptpu_jpeg_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.dptpu_jpeg_decode_crop_resize.restype = ctypes.c_int
+        lib.dptpu_jpeg_decode_crop_resize.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ]
+        _cached = lib
+        return _cached

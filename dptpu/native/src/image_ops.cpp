@@ -1,0 +1,189 @@
+// dptpu native image ops: JPEG decode + fused bilinear crop-resize (+flip).
+//
+// The hot path of the host input pipeline. The reference leans on
+// torchvision's PIL loader + C image ops inside torch DataLoader worker
+// processes (reference imagenet_ddp.py:166-194); this is the dptpu-native
+// equivalent: a small C core driven from Python threads via ctypes (the
+// call releases the GIL, so a thread pool scales across cores without
+// process forking).
+//
+// Two tricks make it faster than the PIL path:
+//  1. libjpeg scaled decode (scale_num/8): when the sampled crop will be
+//     downscaled to out_size anyway, decode directly at 1/2, 3/8, ... of
+//     full resolution — typically 3-6x less IDCT + color-convert work for
+//     ImageNet-sized JPEGs cropped to 224.
+//  2. crop+resize+flip fused into one bilinear gather straight into the
+//     caller's batch slot — no intermediate full-size RGB copy beyond the
+//     decode buffer, no per-item allocation in steady state.
+//
+// C ABI (ctypes): all functions return 0 on success, negative on failure
+// (caller falls back to the PIL path — e.g. PNGs land there).
+
+#include <cstddef>
+#include <cstdio>  // jpeglib.h uses FILE/size_t without including them
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// Bilinear sample of src (h x w x 3) region [left,top,cw,ch] to out
+// (out_size x out_size x 3), optional horizontal flip. Matches PIL's
+// box-resize semantics: source pixel centers at integer+0.5 coordinates.
+// Horizontal coordinates/weights are identical for every output row, so
+// they are computed once into LUTs; the inner loop is fixed-point (15-bit
+// weights) with two horizontal lerps + one vertical lerp per channel.
+void crop_resize_bilinear(const uint8_t* src, int src_w, int src_h,
+                          double left, double top, double cw, double ch,
+                          int out_size, bool flip, uint8_t* out) {
+  constexpr int kBits = 15;
+  constexpr int kOne = 1 << kBits;
+  const double sx = cw / out_size;
+  const double sy = ch / out_size;
+
+  std::vector<int> x0s(out_size), x1s(out_size), wxs(out_size);
+  for (int ox = 0; ox < out_size; ++ox) {
+    const int tx = flip ? (out_size - 1 - ox) : ox;
+    const double fx = left + (tx + 0.5) * sx - 0.5;
+    int x0 = static_cast<int>(std::floor(fx));
+    const double wx = fx - x0;
+    int x1 = x0 + 1;
+    x0s[ox] = std::clamp(x0, 0, src_w - 1) * 3;
+    x1s[ox] = std::clamp(x1, 0, src_w - 1) * 3;
+    wxs[ox] = static_cast<int>(wx * kOne + 0.5);
+  }
+
+  for (int oy = 0; oy < out_size; ++oy) {
+    const double fy = top + (oy + 0.5) * sy - 0.5;
+    int y0 = static_cast<int>(std::floor(fy));
+    const double wyd = fy - y0;
+    int y1 = y0 + 1;
+    y0 = std::clamp(y0, 0, src_h - 1);
+    y1 = std::clamp(y1, 0, src_h - 1);
+    const int wy = static_cast<int>(wyd * kOne + 0.5);
+    const uint8_t* row0 = src + static_cast<size_t>(y0) * src_w * 3;
+    const uint8_t* row1 = src + static_cast<size_t>(y1) * src_w * 3;
+    uint8_t* orow = out + static_cast<size_t>(oy) * out_size * 3;
+    for (int ox = 0; ox < out_size; ++ox) {
+      const int x0 = x0s[ox], x1 = x1s[ox], wx = wxs[ox];
+      for (int c = 0; c < 3; ++c) {
+        const int t0 = (row0[x0 + c] << kBits) +
+                       (row0[x1 + c] - row0[x0 + c]) * wx;
+        const int t1 = (row1[x0 + c] << kBits) +
+                       (row1[x1 + c] - row1[x0 + c]) * wx;
+        const int64_t v =
+            (static_cast<int64_t>(t0) << kBits) +
+            static_cast<int64_t>(t1 - t0) * wy;
+        orow[ox * 3 + c] =
+            static_cast<uint8_t>((v + (1ll << (2 * kBits - 1))) >> (2 * kBits));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse JPEG header only; writes full-resolution dimensions.
+int dptpu_jpeg_dims(const uint8_t* data, size_t size, int* width,
+                    int* height) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  *width = static_cast<int>(cinfo.image_width);
+  *height = static_cast<int>(cinfo.image_height);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode + crop box (full-resolution coords) + bilinear resize to
+// out_size x out_size RGB + optional horizontal flip, into `out`
+// (out_size*out_size*3 bytes, caller-allocated).
+int dptpu_jpeg_decode_crop_resize(const uint8_t* data, size_t size,
+                                  int crop_left, int crop_top, int crop_w,
+                                  int crop_h, int out_size, int flip,
+                                  uint8_t* out) {
+  if (crop_w <= 0 || crop_h <= 0 || out_size <= 0) return -3;
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  std::vector<uint8_t> pixels;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  // Scaled decode: largest downscale such that the decoded crop still has
+  // >= out_size pixels on each axis (never upsample a crop we'd then
+  // shrink; keep full quality when the crop must be enlarged).
+  int num = 8;
+  while (num > 1) {
+    const int cand = num - 1;
+    if (crop_w * cand >= out_size * 8 && crop_h * cand >= out_size * 8) {
+      num = cand;
+    } else {
+      break;
+    }
+  }
+  cinfo.scale_num = static_cast<unsigned>(num);
+  cinfo.scale_denom = 8;
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.dct_method = JDCT_IFAST;  // augmentation path: speed over the last
+                                  // fraction of a bit of DCT precision
+  jpeg_start_decompress(&cinfo);
+  const int dw = static_cast<int>(cinfo.output_width);
+  const int dh = static_cast<int>(cinfo.output_height);
+  pixels.resize(static_cast<size_t>(dw) * dh * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = pixels.data() +
+                   static_cast<size_t>(cinfo.output_scanline) * dw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  const double full_w = static_cast<double>(cinfo.image_width);
+  const double full_h = static_cast<double>(cinfo.image_height);
+  jpeg_destroy_decompress(&cinfo);
+
+  // Map the full-resolution crop box into decoded coordinates.
+  const double rx = dw / full_w;
+  const double ry = dh / full_h;
+  crop_resize_bilinear(pixels.data(), dw, dh, crop_left * rx, crop_top * ry,
+                       crop_w * rx, crop_h * ry, out_size, flip != 0, out);
+  return 0;
+}
+
+}  // extern "C"

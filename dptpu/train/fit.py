@@ -194,6 +194,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         )
         ckpt_dir = writer.log_dir  # apex checkpoints into the run dir (:271-277)
 
+    # structured tracing (SURVEY.md §5: the reference has only wall-clock
+    # meters; dptpu adds an opt-in XLA profile): DPTPU_PROFILE=<dir> traces
+    # the first training epoch into a TensorBoard-viewable profile.
+    import os as _os
+
+    profile_dir = _os.environ.get("DPTPU_PROFILE")
+    if profile_dir and derived.is_chief:
+        jax.profiler.start_trace(profile_dir)
+
     start_time = time.time()
     result = {"history": [], "early_stopped": False, "training_time": None}
     for epoch in range(start_epoch, cfg.epochs):
@@ -206,6 +215,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             print_freq=cfg.print_freq,
             verbose=verbose,
         )
+        if profile_dir and derived.is_chief and epoch == start_epoch:
+            jax.profiler.stop_trace()
+            profile_dir = None
         val_stats = validate(
             state,
             eval_step,

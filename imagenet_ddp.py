@@ -10,16 +10,7 @@ wrapper). ``--dist-backend``/``--world-size``/``--rank``/``--dist-url`` keep
 their reference semantics, mapped onto ``jax.distributed.initialize``.
 """
 
-from dptpu.config import parse_config
-from dptpu.train import fit
-
-
-def main():
-    cfg = parse_config(variant="ddp")
-    result = fit(cfg)
-    if result.get("early_stopped"):
-        print(f"early stop: training_time {result['training_time']:.1f}s")
-
+from dptpu.cli import main_ddp
 
 if __name__ == "__main__":
-    main()
+    main_ddp()

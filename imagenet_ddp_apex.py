@@ -15,14 +15,7 @@ reference (:63-67). Launch: one process per host with WORLD_SIZE/RANK/
 MASTER_ADDR env vars (env:// rendezvous), not one per chip.
 """
 
-from dptpu.config import parse_config
-from dptpu.train import fit
-
-
-def main():
-    cfg = parse_config(variant="apex").replace(dist_url="env://")
-    fit(cfg)
-
+from dptpu.cli import main_apex
 
 if __name__ == "__main__":
-    main()
+    main_apex()

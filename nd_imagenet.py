@@ -11,14 +11,7 @@ reproducibility (XLA is deterministic by default — no cudnn.deterministic
 trade-off, nd_imagenet.py:84-92).
 """
 
-from dptpu.config import parse_config
-from dptpu.train import fit
-
-
-def main():
-    cfg = parse_config(variant="nd")
-    fit(cfg)
-
+from dptpu.cli import main_nd
 
 if __name__ == "__main__":
-    main()
+    main_nd()

@@ -1,0 +1,100 @@
+"""Native C++ image ops: build, decode correctness vs PIL, fallback paths."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dptpu.data import native_image
+from dptpu.data.dataset import ImageFolderDataset
+from dptpu.data.transforms import TrainTransform, ValTransform
+
+pytestmark = pytest.mark.skipif(
+    not native_image.available(), reason="native toolchain/libjpeg unavailable"
+)
+
+
+def _jpeg_bytes(arr, quality=95):
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _smooth_image(w, h):
+    # smooth gradient → JPEG-compresses nearly losslessly, so decoder
+    # differences dominate the comparison, not compression artifacts
+    x = np.linspace(0, 255, w, dtype=np.float32)
+    y = np.linspace(0, 255, h, dtype=np.float32)[:, None]
+    r = np.broadcast_to(x, (h, w))
+    g = np.broadcast_to(y, (h, w))
+    b = (r + g) / 2
+    return np.stack([r, g, b], axis=-1).astype(np.uint8)
+
+
+def test_jpeg_dims():
+    data = _jpeg_bytes(_smooth_image(320, 200))
+    assert native_image.jpeg_dims(data) == (320, 200)
+    assert native_image.jpeg_dims(b"not a jpeg") is None
+
+
+def test_decode_matches_pil_closely():
+    arr = _smooth_image(400, 300)
+    data = _jpeg_bytes(arr)
+    box = (40, 30, 300, 240)
+    native = native_image.decode_crop_resize(data, box, 224, flip=False)
+    assert native is not None and native.shape == (224, 224, 3)
+
+    with Image.open(io.BytesIO(data)) as img:
+        pil = np.asarray(
+            img.convert("RGB").resize(
+                (224, 224), 2, box=(40, 30, 340, 270)
+            ),
+            dtype=np.uint8,
+        )
+    diff = np.abs(native.astype(int) - pil.astype(int))
+    # same pixels selected; small resampler differences allowed
+    assert np.mean(diff) < 3.0, np.mean(diff)
+    assert np.percentile(diff, 99) <= 12
+
+
+def test_decode_flip():
+    arr = _smooth_image(256, 256)
+    data = _jpeg_bytes(arr)
+    box = (0, 0, 256, 256)
+    plain = native_image.decode_crop_resize(data, box, 64, flip=False)
+    flipped = native_image.decode_crop_resize(data, box, 64, flip=True)
+    np.testing.assert_array_equal(plain[:, ::-1], flipped)
+
+
+def test_scaled_decode_still_accurate():
+    # large source, small crop target → libjpeg scale path engages
+    arr = _smooth_image(1600, 1200)
+    data = _jpeg_bytes(arr)
+    box = ValTransform(224, 256).sample(1600, 1200)[0]
+    native = native_image.decode_crop_resize(data, box, 224, flip=False)
+    with Image.open(io.BytesIO(data)) as img:
+        left, top, cw, ch = box
+        pil = np.asarray(
+            img.convert("RGB").resize(
+                (224, 224), 2, box=(left, top, left + cw, top + ch)
+            ),
+            dtype=np.uint8,
+        )
+    assert np.mean(np.abs(native.astype(int) - pil.astype(int))) < 4.0
+
+
+def test_dataset_native_path_and_png_fallback(tmp_path):
+    arr = _smooth_image(300, 300)
+    d = tmp_path / "train" / "c0"
+    d.mkdir(parents=True)
+    Image.fromarray(arr).save(d / "a.jpg", quality=95)
+    Image.fromarray(arr).save(d / "b.png")
+    ds = ImageFolderDataset(str(tmp_path / "train"), TrainTransform(64))
+    rng_a = np.random.default_rng(7)
+    rng_b = np.random.default_rng(7)
+    img_jpg, _ = ds.get(0, rng_a)  # native path
+    img_png, _ = ds.get(1, rng_b)  # PIL fallback, same rng stream → same box
+    assert img_jpg.shape == img_png.shape == (64, 64, 3)
+    # same sampled crop on (nearly) identical sources → near-identical output
+    assert np.mean(np.abs(img_jpg.astype(int) - img_png.astype(int))) < 4.0
