@@ -138,6 +138,10 @@ class _Stem(nn.Module):
                 x, kernel, (2, 2), ((3, 3), (3, 3)), dimension_numbers=dn
             )
         b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"space-to-depth stem requires even input H/W, got {h}x{w}"
+            )
         # pad to the conv's receptive field, rounded up even for 2×2 blocks
         xp = jnp.pad(x, ((0, 0), (3, 5), (3, 5), (0, 0)))
         hp, wp = h + 8, w + 8

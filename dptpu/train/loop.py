@@ -59,15 +59,20 @@ def train_one_epoch(
         state, metrics = train_step(state, batch)
         pending.append((metrics, n))
         if i % print_freq == 0:
-            # one sync for the whole interval: block on the newest metrics
-            for m, nb in jax.device_get(
-                [(p[0], p[1]) for p in pending]
-            ):
+            # one sync per interval — but lag it: blocking on the newest
+            # (still in-flight) step would drain the dispatch queue and pay
+            # the ~100ms refill documented in PERF.md, so keep the last two
+            # steps un-fetched and in flight. The first display (i == 0)
+            # fetches everything so the epoch's opening line shows real
+            # values (the queue is cold there anyway).
+            lag = 0 if i == 0 else 2
+            cut = max(len(pending) - lag, 0)
+            ready, pending = pending[:cut], pending[cut:]
+            for m, nb in jax.device_get([(p[0], p[1]) for p in ready]):
                 losses.update(float(m["loss"]), nb)
                 top1.update(float(m["top1"]), nb)
                 top5.update(float(m["top5"]), nb)
                 last_lr = float(m.get("lr", last_lr))
-            pending.clear()
             batch_time.update(time.time() - end)
             if verbose:
                 progress.display(i)
