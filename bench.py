@@ -80,20 +80,27 @@ def main():
     # cost (relay round-trip + pipeline refill) that a single window would
     # book against throughput. t(long) - t(short) cancels it exactly and
     # yields the steady-state step time — which matches the per-op device
-    # time sum from the XLA trace (PERF.md). Best of 2 to shed contention.
+    # time sum from the XLA trace (PERF.md). The short/long order alternates
+    # between trials (the first window after idle runs 2-3% off steady
+    # state, so a fixed order would bias the difference one way) and the
+    # reported rate is the median of per-trial rates, so one contention
+    # spike in either window cannot be cherry-picked.
     short_iters, long_iters = 20, 120
-    trials = []
-    for _ in range(2):
-        t_short = window(short_iters)
-        t_long = window(long_iters)
+    rates = []
+    for trial in range(2):
+        if trial % 2 == 0:
+            t_short = window(short_iters)
+            t_long = window(long_iters)
+        else:
+            t_long = window(long_iters)
+            t_short = window(short_iters)
         if t_long > t_short:  # a contention spike in the short window can
-            trials.append((t_long, t_short))  # invert the difference
-    if not trials:
+            rates.append(      # invert the difference; skip such trials
+                global_batch * (long_iters - short_iters) / (t_long - t_short)
+            )
+    if not rates:
         raise RuntimeError("benchmark windows unusable (contention?)")
-    # the trial with the smallest long window saw the least contention;
-    # its difference is the most trustworthy steady-state estimate
-    t_long, t_short = min(trials)
-    rate = global_batch * (long_iters - short_iters) / (t_long - t_short)
+    rate = float(np.median(rates))
 
     per_chip = rate / n_chips
     print(

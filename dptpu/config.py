@@ -146,7 +146,9 @@ def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentPar
                             "collectives over ICI/DCN")
     if variant == "ddp":
         p.add_argument("--desired-acc", default=None, type=float,
-                       help="stop training after desired-acc is reached")
+                       help="stop training once val top-1 reaches this "
+                            "FRACTION (e.g. 0.75 = 75%% top-1, the README's "
+                            "canonical bar); values > 1 are read as percent")
     if variant == "nd":
         p.add_argument("--seed", default=None, type=int,
                        help="seed for initializing training")

@@ -278,8 +278,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             writer.add_scalar("Top5/val", val_stats["top5"], epoch + 1)
             writer.add_scalar("Lr", lr_now, epoch + 1)
         # --desired-acc early stop, fractional like the reference
-        # (README --desired-acc 0.75 vs top1 in percent, imagenet_ddp.py:224-236)
-        if cfg.desired_acc is not None and best_acc1 >= cfg.desired_acc * 100.0:
+        # (README --desired-acc 0.75 vs top1 in percent, imagenet_ddp.py:224-236);
+        # values > 1 are read as percent directly (documented in --help)
+        if cfg.desired_acc is not None:
+            target_pct = (
+                cfg.desired_acc * 100.0
+                if cfg.desired_acc <= 1.0
+                else cfg.desired_acc
+            )
+        if cfg.desired_acc is not None and best_acc1 >= target_pct:
             training_time = time.time() - start_time
             save_checkpoint(
                 state,
@@ -294,7 +301,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if verbose:
                 print(
                     f"top-1 accuracy {best_acc1:.3f} reached desired "
-                    f"{cfg.desired_acc * 100.0:.3f} after {training_time:.1f}s"
+                    f"{target_pct:.3f} after {training_time:.1f}s"
                 )
             result["early_stopped"] = True
             result["training_time"] = training_time

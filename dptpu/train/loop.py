@@ -65,7 +65,9 @@ def train_one_epoch(
             # steps un-fetched and in flight. The first display (i == 0)
             # fetches everything so the epoch's opening line shows real
             # values (the queue is cold there anyway).
-            lag = 0 if i == 0 else 2
+            # (capped below print_freq so short intervals still advance the
+            # display every interval instead of repeating stale values)
+            lag = 0 if i == 0 else min(2, max(print_freq - 1, 0))
             cut = max(len(pending) - lag, 0)
             ready, pending = pending[:cut], pending[cut:]
             for m, nb in jax.device_get([(p[0], p[1]) for p in ready]):

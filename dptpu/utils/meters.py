@@ -1,18 +1,21 @@
 """Running-statistics meters with the reference's exact display surface.
 
-The reference ships three meter variants with one shared core:
+The *console bytes* are contractual — the reference prints
+``"{name} {val:fmt} ({avg:fmt})"`` meter strings (imagenet_ddp.py:333-354),
+``"<prefix>[i/N]\\t<meter>\\t..."`` progress lines (imagenet_ddp.py:357-371),
+``" * <summaries>"`` epilogue lines with a ``Summary`` enum selecting
+avg/sum/count (nd_imagenet.py:361-421), and the Apex variant's nameless
+meters (imagenet_ddp_apex.py:509-524, covered by the ``name=""`` default).
+That surface is locked byte-for-byte by the golden test in
+``tests/test_meters.py::test_golden_console_surface``.
 
-* ``AverageMeter(name, fmt)`` with ``val/sum/count/avg`` running stats and a
-  ``"{name} {val:fmt} ({avg:fmt})"`` string form (imagenet_ddp.py:333-354).
-* The Apex variant drops ``name``/``fmt`` (imagenet_ddp_apex.py:509-524) —
-  covered here by the defaults.
-* The nd variant adds a ``Summary`` enum {NONE, AVERAGE, SUM, COUNT} and a
-  ``summary()`` formatter (nd_imagenet.py:361-404).
-
-``ProgressMeter`` prints ``"<prefix>[i/N]\\t<meter>\\t<meter>..."`` lines
-(imagenet_ddp.py:357-371) plus the nd variant's ``display_summary()``
-(nd_imagenet.py:418-421). This single implementation is a superset of all
-three, so every entry point shares one meter surface.
+The *internals* are dptpu's own: a meter is a weighted accumulator pair
+``(total, weight)`` plus the last observed value, and ``val/avg/sum/count``
+are derived read-only properties rather than four mutable fields updated in
+lockstep — there is no state that can drift out of sync, and ``avg`` is
+well-defined (0) even before the first update. Formatting goes through
+:func:`format` with the spec string directly instead of building and
+re-parsing a ``str.format`` template per call.
 """
 
 from enum import Enum
@@ -25,8 +28,22 @@ class Summary(Enum):
     COUNT = 3
 
 
+# Summary variant -> which derived statistic it reports (None = silent).
+_SUMMARY_STAT = {
+    Summary.NONE: None,
+    Summary.AVERAGE: "avg",
+    Summary.SUM: "sum",
+    Summary.COUNT: "count",
+}
+
+
 class AverageMeter:
-    """Computes and stores the average and current value."""
+    """Weighted running average with the reference meter's display surface.
+
+    ``update(v, n)`` folds in ``n`` observations of value ``v``;
+    ``val``/``avg``/``sum``/``count`` are derived properties over the
+    ``(total, weight, last)`` accumulator state.
+    """
 
     def __init__(self, name="", fmt=":f", summary_type=Summary.AVERAGE):
         self.name = name
@@ -35,53 +52,74 @@ class AverageMeter:
         self.reset()
 
     def reset(self):
-        self.val = 0
-        self.avg = 0
-        self.sum = 0
-        self.count = 0
+        self._last = 0
+        self._total = 0
+        self._weight = 0
 
     def update(self, val, n=1):
-        self.val = val
-        self.sum += val * n
-        self.count += n
-        self.avg = self.sum / self.count
+        self._last = val
+        self._total += val * n
+        self._weight += n
+
+    @property
+    def val(self):
+        """Most recently observed value (0 before any update)."""
+        return self._last
+
+    @property
+    def sum(self):
+        """Weighted sum of observed values."""
+        return self._total
+
+    @property
+    def count(self):
+        """Total observation weight."""
+        return self._weight
+
+    @property
+    def avg(self):
+        """Weighted mean; 0 for an empty meter (matching a fresh reset)."""
+        return self._total / self._weight if self._weight else 0
+
+    def _format(self, value):
+        # fmt is a ":"-prefixed format spec (e.g. ":6.2f"); apply it directly
+        return format(value, self.fmt[1:] if self.fmt.startswith(":") else self.fmt)
 
     def __str__(self):
-        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
-        return fmtstr.format(**self.__dict__)
+        # "{name} {val:fmt} ({avg:fmt})" — imagenet_ddp.py:352-354
+        return f"{self.name} {self._format(self.val)} ({self._format(self.avg)})"
 
     def summary(self):
-        if self.summary_type is Summary.NONE:
-            fmtstr = ""
-        elif self.summary_type is Summary.AVERAGE:
-            fmtstr = "{name} {avg:.3f}"
-        elif self.summary_type is Summary.SUM:
-            fmtstr = "{name} {sum:.3f}"
-        elif self.summary_type is Summary.COUNT:
-            fmtstr = "{name} {count:.3f}"
-        else:
-            raise ValueError("invalid summary type %r" % self.summary_type)
-        return fmtstr.format(**self.__dict__)
+        # " {name} {stat:.3f}" per Summary variant — nd_imagenet.py:389-404
+        try:
+            stat = _SUMMARY_STAT[self.summary_type]
+        except (KeyError, TypeError):
+            raise ValueError(f"invalid summary type {self.summary_type!r}")
+        if stat is None:
+            return ""
+        return f"{self.name} {getattr(self, stat):.3f}"
 
 
 class ProgressMeter:
+    """Prints ``<prefix>[i/N]`` progress lines over a set of meters.
+
+    The batch counter is right-aligned to the width of ``N`` so columns stay
+    stable across an epoch (``[  7/391]``), exactly the reference's line
+    shape (imagenet_ddp.py:357-371; summary epilogue nd_imagenet.py:418-421).
+    """
+
     def __init__(self, num_batches, meters, prefix=""):
-        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
+        self.num_batches = num_batches
         self.meters = meters
         self.prefix = prefix
 
+    def _counter(self, batch):
+        width = len(str(self.num_batches))
+        return f"[{batch:{width}d}/{self.num_batches}]"
+
     def display(self, batch):
-        entries = [self.prefix + self.batch_fmtstr.format(batch)]
-        entries += [str(meter) for meter in self.meters]
-        print("\t".join(entries))
+        print("\t".join([self.prefix + self._counter(batch),
+                         *(str(m) for m in self.meters)]))
 
     def display_summary(self):
-        entries = [" *"]
-        entries += [meter.summary() for meter in self.meters]
-        print(" ".join(entries))
-
-    @staticmethod
-    def _get_batch_fmtstr(num_batches):
-        num_digits = len(str(num_batches // 1))
-        fmt = "{:" + str(num_digits) + "d}"
-        return "[" + fmt + "/" + fmt.format(num_batches) + "]"
+        print(" ".join([" *", *(m.summary() for m in self.meters)]))
