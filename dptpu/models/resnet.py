@@ -33,6 +33,7 @@ from flax import linen as nn
 from jax import lax
 
 from dptpu.models.layers import (
+    FusedBNReLUPool,
     kaiming_normal_fan_out,
     max_pool_same_as_torch,
     torch_default_bias_init,
@@ -174,6 +175,13 @@ class ResNet(nn.Module):
     # space-to-depth stem (see _Stem): identical math + identical params,
     # faster on MXU. Requires even input H/W.
     stem_space_to_depth: bool = False
+    # fused stem pool: run bn1 -> relu -> maxpool as the custom-VJP region
+    # of dptpu.ops.fused_stem (Pallas kernels on TPU). Identical params and
+    # batch_stats (checkpoints interchange); activation numerics shift by
+    # <= 1 ulp because the affine folds the statistics before multiplying.
+    # Opt-in (DPTPU_FUSED_STEM=1): correct and parity-tested, but measured
+    # slower than XLA's native stem on v5e Mosaic — see PERF.md.
+    fused_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -184,12 +192,15 @@ class ResNet(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=kaiming_normal_fan_out,
         )
+        bn_momentum = 0.9  # torch BN momentum 0.1 == flax EMA decay 0.9
+        bn_epsilon = 1e-5
+        bn_io_dtype = self.bn_dtype if self.bn_dtype is not None else self.dtype
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
-            momentum=0.9,  # torch BN momentum 0.1 == flax EMA decay 0.9
-            epsilon=1e-5,
-            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            momentum=bn_momentum,
+            epsilon=bn_epsilon,
+            dtype=bn_io_dtype,
             param_dtype=jnp.float32,
             axis_name=self.bn_axis_name,
         )
@@ -199,9 +210,19 @@ class ResNet(nn.Module):
             space_to_depth=self.stem_space_to_depth,
             name="conv1",
         )(x)
-        x = norm(name="bn1")(x)
-        x = nn.relu(x)
-        x = max_pool_same_as_torch(x, 3, 2, 1)
+        if self.fused_stem:
+            x = FusedBNReLUPool(
+                use_running_average=not train,
+                momentum=bn_momentum,
+                epsilon=bn_epsilon,
+                axis_name=self.bn_axis_name,
+                dtype=bn_io_dtype,
+                name="bn1",
+            )(x)
+        else:
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+            x = max_pool_same_as_torch(x, 3, 2, 1)
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 x = self.block_cls(

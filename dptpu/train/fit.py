@@ -147,6 +147,11 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # v5e-1 (order-balanced interleaved A/B, 6 reps) — XLA's native
         # small-channel conv handling already covers this chip.
         **({"stem_space_to_depth": True} if use_s2d else {}),
+        # fused Pallas stem (bn1+relu+maxpool custom-VJP region): opt-in,
+        # parity-tested; slower than XLA's stem on v5e Mosaic (PERF.md)
+        **({"fused_stem": True}
+           if _os_environ_flag("DPTPU_FUSED_STEM")
+           and cfg.arch.startswith("resnet") else {}),
     )
     if cfg.variant == "apex":
         schedule = make_warmup_step_decay_schedule(derived.scaled_lr, steps_per_epoch)
@@ -280,13 +285,14 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # --desired-acc early stop, fractional like the reference
         # (README --desired-acc 0.75 vs top1 in percent, imagenet_ddp.py:224-236);
         # values > 1 are read as percent directly (documented in --help)
-        if cfg.desired_acc is not None:
-            target_pct = (
-                cfg.desired_acc * 100.0
-                if cfg.desired_acc <= 1.0
-                else cfg.desired_acc
-            )
-        if cfg.desired_acc is not None and best_acc1 >= target_pct:
+        target_pct = (
+            None
+            if cfg.desired_acc is None
+            else cfg.desired_acc * 100.0
+            if cfg.desired_acc <= 1.0
+            else cfg.desired_acc
+        )
+        if target_pct is not None and best_acc1 >= target_pct:
             training_time = time.time() - start_time
             save_checkpoint(
                 state,

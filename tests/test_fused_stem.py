@@ -1,0 +1,153 @@
+"""Fused stem (BN-affine + ReLU + maxpool custom-VJP region) parity.
+
+The region must reproduce the stock stem — flax BN apply -> relu ->
+``nn.max_pool(3,2,1)`` whose backward is XLA's select_and_scatter
+(first-max GE tie-break) — exactly in routing and to float tolerance in
+values (the affine folds the statistics before multiplying, a <= 1 ulp
+reassociation). Pallas kernels are exercised in interpreter mode on CPU
+and must match the XLA implementation bitwise.
+Ref: the stem being fused, torchvision resnet via imagenet_ddp.py:108-114.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from dptpu.models import create_model
+from dptpu.models.layers import FusedBNReLUPool
+from dptpu.ops import fused_stem as fs
+
+
+def _stock_region(z, gamma_t, beta_t):
+    x = nn.relu(gamma_t * z + beta_t)
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_xla_bwd_matches_select_and_scatter_f32(tie):
+    """With an identity-affine in f32 the region is relu∘maxpool exactly,
+    so dz must match select_and_scatter's routing bitwise (incl. ties)."""
+    rng = np.random.RandomState(0)
+    z = rng.randn(2, 12, 12, 8).astype(np.float32)
+    if tie:
+        z = np.maximum(np.round(z * 2) / 2, 0.0)  # many ties incl. zeros
+    z = jnp.asarray(z)
+    ones = jnp.ones((8,), jnp.float32)
+    zeros = jnp.zeros((8,), jnp.float32)
+    g = jnp.asarray(rng.randn(2, 6, 6, 8), jnp.float32)
+
+    y_ref, vjp_ref = jax.vjp(_stock_region, z, ones, zeros)
+    y_fus, vjp_fus = jax.vjp(fs.affine_relu_pool, z, ones, zeros)
+    assert bool(jnp.all(y_ref == y_fus))
+    dz_ref = vjp_ref(g)[0]
+    dz_fus = vjp_fus(g)[0]
+    assert bool(jnp.all(dz_ref == dz_fus)), "routing differs from XLA S&S"
+
+
+def test_xla_affine_grads_match_autodiff():
+    """d(gamma_t)/d(beta_t) from the small-grid identities must match
+    autodiff of the stock region to float tolerance."""
+    rng = np.random.RandomState(1)
+    z = jnp.asarray(rng.randn(2, 8, 8, 4), jnp.float32)
+    gam = jnp.asarray(rng.randn(4) * 0.5 + 1.0, jnp.float32)
+    gam = gam.at[0].set(-0.8)  # negative scale flips the ordering
+    bet = jnp.asarray(rng.randn(4) * 0.1, jnp.float32)
+    g = jnp.asarray(rng.randn(2, 4, 4, 4), jnp.float32)
+
+    _, vjp_ref = jax.vjp(_stock_region, z, gam, bet)
+    _, vjp_fus = jax.vjp(fs.affine_relu_pool, z, gam, bet)
+    for a, b in zip(vjp_ref(g), vjp_fus(g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_interpret_matches_xla():
+    """Pallas kernels (interpreter mode on CPU) are bitwise-identical to
+    the XLA implementation, forward and backward."""
+    rng = np.random.RandomState(2)
+    z = jnp.asarray(np.round(rng.randn(2, 8, 8, 64) * 2) / 2, jnp.bfloat16)
+    gam = jnp.asarray(rng.randn(64) * 0.5 + 1.0, jnp.bfloat16)
+    bet = jnp.asarray(rng.randn(64) * 0.1, jnp.bfloat16)
+    g = jnp.asarray(rng.randn(2, 4, 4, 64), jnp.bfloat16)
+
+    y_x = fs._fwd_xla(z, gam, bet)
+    y_p = fs._fwd_pallas(z, gam, bet, interpret=True)
+    assert bool(jnp.all(y_x == y_p))
+
+    dz_x, dg_x, db_x = fs._bwd_xla(z, gam, bet, y_x, g)
+    dz_p, dg_p, db_p = fs._bwd_pallas(z, gam, bet, g, interpret=True)
+    assert bool(jnp.all(dz_x == dz_p))
+    np.testing.assert_allclose(np.asarray(dg_x), np.asarray(dg_p), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(db_x), np.asarray(db_p), rtol=1e-6)
+
+
+def test_fused_module_matches_flax_bn_stem():
+    """FusedBNReLUPool == flax BatchNorm -> relu -> max_pool: same output
+    (float tolerance), same running-stat updates, same param/stat names."""
+
+    class Stock(nn.Module):
+        train: bool = False
+
+        @nn.compact
+        def __call__(self, z):
+            x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9,
+                             epsilon=1e-5, param_dtype=jnp.float32,
+                             name="bn1")(z)
+            x = nn.relu(x)
+            return nn.max_pool(x, (3, 3), strides=(2, 2),
+                               padding=((1, 1), (1, 1)))
+
+    class Fused(nn.Module):
+        train: bool = False
+
+        @nn.compact
+        def __call__(self, z):
+            return FusedBNReLUPool(use_running_average=not self.train,
+                                   name="bn1")(z)
+
+    rng = np.random.RandomState(3)
+    z = jnp.asarray(rng.randn(4, 8, 8, 6), jnp.float32)
+    v_s = Stock(train=False).init(jax.random.PRNGKey(0), z)
+    v_f = Fused(train=False).init(jax.random.PRNGKey(0), z)
+    assert jax.tree_util.tree_structure(v_s) == jax.tree_util.tree_structure(v_f)
+
+    # seed non-trivial params/stats into both
+    params = {"bn1": {"scale": jnp.asarray(rng.randn(6) * 0.4 + 1.0, jnp.float32),
+                      "bias": jnp.asarray(rng.randn(6) * 0.2, jnp.float32)}}
+    stats = {"bn1": {"mean": jnp.asarray(rng.randn(6) * 0.1, jnp.float32),
+                     "var": jnp.asarray(rng.rand(6) + 0.5, jnp.float32)}}
+
+    # eval mode: running stats
+    y_s = Stock(train=False).apply({"params": params, "batch_stats": stats}, z)
+    y_f = Fused(train=False).apply({"params": params, "batch_stats": stats}, z)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_f),
+                               rtol=2e-5, atol=2e-5)
+
+    # train mode: batch stats + identical running-stat EMA updates
+    y_s, m_s = Stock(train=True).apply(
+        {"params": params, "batch_stats": stats}, z, mutable=["batch_stats"])
+    y_f, m_f = Fused(train=True).apply(
+        {"params": params, "batch_stats": stats}, z, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_f),
+                               rtol=2e-5, atol=2e-5)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(
+            np.asarray(m_s["batch_stats"]["bn1"][k]),
+            np.asarray(m_f["batch_stats"]["bn1"][k]), rtol=1e-5)
+
+
+def test_resnet_fused_stem_checkpoint_compatible():
+    """fused_stem=True keeps the exact param/stat tree of the stock model
+    and produces close outputs from shared weights."""
+    m0 = create_model("resnet18", num_classes=7)
+    m1 = create_model("resnet18", num_classes=7, fused_stem=True)
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 32, 32, 3), jnp.float32)
+    v0 = m0.init(jax.random.PRNGKey(0), x, train=False)
+    v1 = m1.init(jax.random.PRNGKey(0), x, train=False)
+    assert jax.tree_util.tree_structure(v0) == jax.tree_util.tree_structure(v1)
+    y0 = m0.apply(v0, x, train=False)
+    y1 = m1.apply(v0, x, train=False)  # stock weights through fused model
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=5e-4, atol=5e-4)
