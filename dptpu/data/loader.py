@@ -69,7 +69,7 @@ class DataLoader:
         rng = np.random.default_rng([self.seed, epoch, index])
         return self._get(index, rng)
 
-    def _collate(self, futures):
+    def _collate(self, futures, valid=None):
         n_valid = len(futures)
         out_size = self.batch_size if self.pad_final else n_valid
         first_img, _ = futures[0].result()
@@ -80,23 +80,33 @@ class DataLoader:
             batch_imgs[i] = img
             labels[i] = label
         batch = {"images": batch_imgs, "labels": labels}
-        if n_valid < out_size:  # pad tail by repeating sample 0 + mask it out
+        # the eval mask flags positions an exact aggregation must skip:
+        # batch-tail padding AND the sampler's wrap-around duplicates
+        # (samplers pad shards to equal length, imagenet_ddp.py:175-183).
+        # Wrap-dup masking rides the pad_final (exact-eval) mode only:
+        # train batches keep DistributedSampler's duplicate-sample
+        # semantics and a stable pytree (no mid-epoch mask key).
+        need_mask = n_valid < out_size or (
+            self.pad_final and valid is not None and not valid.all()
+        )
+        if n_valid < out_size:  # pad tail by repeating sample 0
             batch_imgs[n_valid:] = batch_imgs[0]
             labels[n_valid:] = labels[0]
+        if need_mask:
             mask = np.zeros((out_size,), np.float32)
-            mask[:n_valid] = 1.0
+            mask[:n_valid] = (
+                1.0 if valid is None else valid.astype(np.float32)
+            )
             batch["mask"] = mask
         return batch
 
     def epoch(self, epoch: int = 0, prefetch_batches: int = 2) -> Iterator[dict]:
         """Iterate one epoch's batches (``epoch`` reseeds the shuffle —
         the set_epoch analog, imagenet_ddp.py:202)."""
-        indices = self.sampler.indices(epoch)
+        indices, valid = self.sampler.indices_and_validity(epoch)
         nb = len(self)
-        chunks = [
-            indices[b * self.batch_size:(b + 1) * self.batch_size]
-            for b in range(nb)
-        ]
+        sl = lambda b: slice(b * self.batch_size, (b + 1) * self.batch_size)  # noqa: E731
+        chunks = [(indices[sl(b)], valid[sl(b)]) for b in range(nb)]
 
         def submit(chunk):
             return [
@@ -105,15 +115,15 @@ class DataLoader:
 
         pending = deque()
         ahead = 1 + max(0, prefetch_batches)
-        for chunk in chunks[:ahead]:
+        for chunk, _ in chunks[:ahead]:
             pending.append(submit(chunk))
         next_idx = ahead
-        while pending:
+        for b in range(nb):
             futs = pending.popleft()
             if next_idx < nb:
-                pending.append(submit(chunks[next_idx]))
+                pending.append(submit(chunks[next_idx][0]))
                 next_idx += 1
-            yield self._collate(futs)
+            yield self._collate(futs, valid=chunks[b][1])
 
     def close(self):
         self._pool.shutdown(wait=False, cancel_futures=True)

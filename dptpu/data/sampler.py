@@ -36,6 +36,18 @@ class ShardedSampler:
 
     def indices(self, epoch: int = 0) -> np.ndarray:
         """This shard's index slice for ``epoch`` (set_epoch analog)."""
+        return self.indices_and_validity(epoch)[0]
+
+    def indices_and_validity(self, epoch: int = 0):
+        """``(indices, valid)`` for this shard and ``epoch``.
+
+        ``valid`` is a bool array flagging which positions are real samples
+        vs wrap-around padding. DistributedSampler pads by wrap-around so
+        every shard draws the same count (imagenet_ddp.py:175-183) — fine
+        for training, but an *exact* psum-aggregated validation
+        (imagenet_ddp_apex.py:457-460) must not count the duplicated
+        samples twice, so the loader zeroes their mask entries.
+        """
         if self.shuffle:
             order = np.random.RandomState(self.seed + epoch).permutation(
                 self.num_examples
@@ -43,10 +55,14 @@ class ShardedSampler:
         else:
             order = np.arange(self.num_examples)
         total = self.samples_per_shard * self.num_shards
+        valid = np.ones(max(total, order.size), np.bool_)
         if total > order.size:  # pad by wrap-around (DistributedSampler)
+            valid[order.size:] = False
             order = np.concatenate([order, order[: total - order.size]])
         else:
             order = order[:total]
+            valid = valid[:total]
         # interleaved assignment: shard i takes order[i::num_shards],
         # so shards stay disjoint for any epoch
-        return order[self.shard_index::self.num_shards]
+        sl = slice(self.shard_index, None, self.num_shards)
+        return order[sl], valid[sl]

@@ -56,6 +56,45 @@ def test_sampler_disjoint_cover_and_reshuffle():
     assert set(e1.tolist()) == set(range(103))
 
 
+def test_sampler_validity_flags_wrap_around_padding():
+    """Exact-val prerequisite (imagenet_ddp_apex.py:457-460): the union of
+    valid positions across shards covers every example exactly once, and
+    padded duplicates are flagged invalid."""
+    n, shards = 103, 4
+    samplers = [ShardedSampler(n, num_shards=shards, shard_index=i, seed=7)
+                for i in range(shards)]
+    seen = []
+    for s in samplers:
+        idx, valid = s.indices_and_validity(epoch=0)
+        assert idx.shape == valid.shape
+        seen.extend(idx[valid].tolist())
+    assert sorted(seen) == list(range(n))  # each real sample exactly once
+    # evenly divisible: nothing flagged
+    s = ShardedSampler(12, num_shards=4, shard_index=1)
+    _, valid = s.indices_and_validity(0)
+    assert valid.all()
+
+
+def test_loader_masks_wrap_around_duplicates(image_folder):
+    """A val shard whose padding wraps around gets mask zeros on the
+    duplicated samples so psum aggregation stays exact."""
+    ds = ImageFolderDataset(image_folder)  # 15 examples
+    # 4 shards -> ceil(15/4)=4 per shard, 1 wrap duplicate somewhere
+    total_valid = 0
+    for shard in range(4):
+        loader = DataLoader(
+            ds, batch_size=4,
+            sampler=ShardedSampler(len(ds), num_shards=4, shard_index=shard,
+                                   shuffle=False),
+            num_workers=1,
+        )
+        for b in loader.epoch(0):
+            mask = b.get("mask")
+            total_valid += int(mask.sum()) if mask is not None else len(b["labels"])
+        loader.close()
+    assert total_valid == len(ds)  # duplicates excluded exactly
+
+
 def test_sampler_no_shuffle_drop_last():
     s = ShardedSampler(10, num_shards=3, shard_index=2, shuffle=False,
                        drop_last=True)
