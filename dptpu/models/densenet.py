@@ -1,0 +1,175 @@
+"""DenseNet family (121/161/169/201), torchvision-architecture-exact, NHWC.
+
+The reference discovers these through the lowercase-callable registry
+(imagenet_ddp.py:19-21, e.g. ``-a densenet121``). Fresh Flax
+implementation of torchvision's ``densenet.py`` structure:
+
+* stem: 7x7/2 conv (``num_init_features``) -> BN -> ReLU -> 3x3/2 max pool;
+* dense blocks of bottleneck layers ``BN -> ReLU -> 1x1 conv
+  (bn_size * growth) -> BN -> ReLU -> 3x3 conv (growth)``, each layer's
+  output concatenated onto the running feature map (channels-minor concat
+  is free in NHWC — it is exactly the memory layout the MXU wants);
+* transitions ``BN -> ReLU -> 1x1 conv (halve channels) -> 2x2/2 avg pool``
+  between blocks;
+* final BN -> ReLU -> global average pool -> Linear classifier (with bias).
+
+Init matches torchvision's ``_DenseNet.__init__`` loop: conv kernels
+``kaiming_normal_`` (torch default mode='fan_in'), BN scale 1 / bias 0,
+classifier bias 0 with torch's default kaiming-uniform kernel. Parameter
+counts are locked in tests/test_models.py (densenet121 = 7,978,856).
+
+Same compute-policy surface as ResNet: ``dtype`` (bf16 compute),
+``bn_dtype`` (pin BN I/O to f32), ``bn_axis_name`` (SyncBN pmean).
+"""
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    max_pool_same_as_torch,
+    torch_default_kernel_init,
+)
+from dptpu.models.registry import register_model
+
+# kaiming_normal_(mode='fan_in', nonlinearity='relu') — torchvision's
+# DenseNet conv init (ResNet uses fan_out; DenseNet keeps torch's default)
+kaiming_normal_fan_in = nn.initializers.variance_scaling(
+    2.0, "fan_in", "normal"
+)
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    bn_size: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = self.norm(name="norm1")(x)
+        y = nn.relu(y)
+        y = self.conv(self.bn_size * self.growth_rate, (1, 1), name="conv1")(y)
+        y = self.norm(name="norm2")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            self.growth_rate, (3, 3), padding=((1, 1), (1, 1)), name="conv2"
+        )(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    out_features: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.norm(name="norm")(x)
+        x = nn.relu(x)
+        x = self.conv(self.out_features, (1, 1), name="conv")(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    block_config: Sequence[int]
+    growth_rate: int
+    num_init_features: int
+    bn_size: int = 4
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_normal_fan_in,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        x = conv(
+            self.num_init_features,
+            (7, 7),
+            strides=(2, 2),
+            padding=((3, 3), (3, 3)),
+            name="conv0",
+        )(x)
+        x = norm(name="norm0")(x)
+        x = nn.relu(x)
+        x = max_pool_same_as_torch(x, 3, 2, 1)
+        features = self.num_init_features
+        for i, n_layers in enumerate(self.block_config):
+            for j in range(n_layers):
+                x = DenseLayer(
+                    growth_rate=self.growth_rate,
+                    bn_size=self.bn_size,
+                    conv=conv,
+                    norm=norm,
+                    name=f"denseblock{i + 1}_layer{j + 1}",
+                )(x)
+            features += n_layers * self.growth_rate
+            if i != len(self.block_config) - 1:
+                features //= 2
+                x = Transition(
+                    out_features=features,
+                    conv=conv,
+                    norm=norm,
+                    name=f"transition{i + 1}",
+                )(x)
+        x = norm(name="norm5")(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))  # adaptive_avg_pool2d((1,1)) + flatten
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=torch_default_kernel_init,
+            bias_init=nn.initializers.zeros,  # torchvision: constant_(bias, 0)
+            name="classifier",
+        )(x)
+        return x
+
+
+def _densenet(block_config, growth_rate, num_init_features, **kwargs):
+    return DenseNet(
+        block_config=block_config,
+        growth_rate=growth_rate,
+        num_init_features=num_init_features,
+        **kwargs,
+    )
+
+
+@register_model
+def densenet121(**kw):
+    return _densenet((6, 12, 24, 16), 32, 64, **kw)
+
+
+@register_model
+def densenet161(**kw):
+    return _densenet((6, 12, 36, 24), 48, 96, **kw)
+
+
+@register_model
+def densenet169(**kw):
+    return _densenet((6, 12, 32, 32), 32, 64, **kw)
+
+
+@register_model
+def densenet201(**kw):
+    return _densenet((6, 12, 48, 32), 32, 64, **kw)

@@ -1,0 +1,141 @@
+"""SqueezeNet 1.0 / 1.1, torchvision-architecture-exact, NHWC.
+
+Discovered through the lowercase-callable registry like every other arch
+(imagenet_ddp.py:19-21, e.g. ``-a squeezenet1_0``). Fresh Flax build of
+torchvision's ``squeezenet.py``:
+
+* 1.0: 7x7/2 conv (96) -> fires (16,64,64)x2,(32,128,128) -> pool ->
+  (32,128,128),(48,192,192)x2,(64,256,256) -> pool -> (64,256,256);
+* 1.1: 3x3/2 conv (64) with the pools moved earlier (the "2.4x less
+  computation" variant);
+* Fire module: 1x1 squeeze -> ReLU -> concat(1x1 expand, 3x3 expand), all
+  with bias;
+* classifier: Dropout(0.5) -> 1x1 conv to num_classes -> ReLU -> global
+  average pool (fully-convolutional head — no Linear).
+
+torchvision's max pools here use ``ceil_mode=True``; ``_ceil_max_pool``
+reproduces that by padding the bottom/right with -inf exactly when the
+ceil-rounded output needs it. Init matches torchvision: the final conv
+N(0, 0.01), every other conv ``kaiming_uniform_`` (bound sqrt(6/fan_in)),
+all biases 0. Param counts locked in tests/test_models.py
+(squeezenet1_0 = 1,248,424 / squeezenet1_1 = 1,235,496).
+"""
+
+from functools import partial
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dptpu.models.registry import register_model
+
+# kaiming_uniform_(a=0, fan_in, leaky_relu): bound sqrt(6/fan_in)
+kaiming_uniform_fan_in = nn.initializers.variance_scaling(
+    2.0, "fan_in", "uniform"
+)
+
+
+def _ceil_max_pool(x, window=3, stride=2):
+    """``nn.MaxPool2d(window, stride, ceil_mode=True)`` on NHWC input."""
+    _, h, w, _ = x.shape
+    oh = -(-(h - window) // stride) + 1
+    ow = -(-(w - window) // stride) + 1
+    pad_h = max(0, (oh - 1) * stride + window - h)
+    pad_w = max(0, (ow - 1) * stride + window - w)
+    return nn.max_pool(
+        x, (window, window), strides=(stride, stride),
+        padding=((0, pad_h), (0, pad_w)),
+    )
+
+
+class Fire(nn.Module):
+    squeeze: int
+    expand1x1: int
+    expand3x3: int
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x):
+        s = nn.relu(self.conv(self.squeeze, (1, 1), name="squeeze")(x))
+        e1 = nn.relu(self.conv(self.expand1x1, (1, 1), name="expand1x1")(s))
+        e3 = nn.relu(
+            self.conv(
+                self.expand3x3, (3, 3), padding=((1, 1), (1, 1)),
+                name="expand3x3",
+            )(s)
+        )
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+# (squeeze, expand1x1, expand3x3) per fire module; "P" = ceil max pool
+_PLANS = {
+    "1_0": [
+        ("conv", 96, 7, 2), "P",
+        ("fire", 16, 64, 64), ("fire", 16, 64, 64), ("fire", 32, 128, 128),
+        "P",
+        ("fire", 32, 128, 128), ("fire", 48, 192, 192),
+        ("fire", 48, 192, 192), ("fire", 64, 256, 256),
+        "P",
+        ("fire", 64, 256, 256),
+    ],
+    "1_1": [
+        ("conv", 64, 3, 2), "P",
+        ("fire", 16, 64, 64), ("fire", 16, 64, 64), "P",
+        ("fire", 32, 128, 128), ("fire", 32, 128, 128), "P",
+        ("fire", 48, 192, 192), ("fire", 48, 192, 192),
+        ("fire", 64, 256, 256), ("fire", 64, 256, 256),
+    ],
+}
+
+
+class SqueezeNet(nn.Module):
+    version: str = "1_0"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Any = None  # no BN; accepted for API uniformity
+    bn_dtype: Any = None  # likewise
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_uniform_fan_in,
+            bias_init=nn.initializers.zeros,
+        )
+        fire_idx = 1
+        for spec in _PLANS[self.version]:
+            if spec == "P":
+                x = _ceil_max_pool(x)
+            elif spec[0] == "conv":
+                _, feats, k, s = spec
+                x = nn.relu(
+                    conv(feats, (k, k), strides=(s, s), name="conv1")(x)
+                )
+            else:
+                _, sq, e1, e3 = spec
+                fire_idx += 1
+                x = Fire(squeeze=sq, expand1x1=e1, expand3x3=e3, conv=conv,
+                         name=f"fire{fire_idx}")(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        # final conv: N(0, 0.01) kernel, zero bias (torchvision final_conv)
+        x = conv(
+            self.num_classes, (1, 1),
+            kernel_init=nn.initializers.normal(0.01),
+            name="final_conv",
+        )(x)
+        x = nn.relu(x)
+        return x.mean(axis=(1, 2))  # AdaptiveAvgPool2d((1,1)) + flatten
+
+
+@register_model
+def squeezenet1_0(**kw):
+    return SqueezeNet(version="1_0", **kw)
+
+
+@register_model
+def squeezenet1_1(**kw):
+    return SqueezeNet(version="1_1", **kw)

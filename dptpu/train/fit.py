@@ -104,22 +104,40 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             shuffle=True,
             seed=cfg.seed if cfg.seed is not None else 0,
         ),
-        num_workers=cfg.workers,
+        # the sum of the reference's per-GPU worker pools: each of the
+        # n_local device-slots gets ceil(workers / n_local) decode threads
+        # (imagenet_ddp.py:126), pooled in this host's single loader
+        num_workers=derived.workers_per_device * derived.local_device_count,
         drop_last=True,
         pad_final=False,
         seed=cfg.seed if cfg.seed is not None else 0,
     )
+    # Validation sharding follows the reference's split behavior:
+    # * ddp/nd validate the FULL val set on every rank with no cross-rank
+    #   reduction (imagenet_ddp.py:186-194, nd_imagenet.py) — here every
+    #   HOST loads the full set; the in-step psum then counts each sample
+    #   once per host, so the reported count is divided back down and the
+    #   averages are bit-identical on every host by construction;
+    # * apex shards val and all-reduces the sums — exact aggregation
+    #   (imagenet_ddp_apex.py:232-234,457-460).
+    full_val = cfg.variant in ("ddp", "nd")
     val_loader = DataLoader(
         val_ds,
         host_batch,
-        sampler=ShardedSampler(
-            len(val_ds),
-            num_shards=derived.num_processes,
-            shard_index=derived.process_index,
-            shuffle=False,
+        sampler=(
+            ShardedSampler(len(val_ds), num_shards=1, shard_index=0,
+                           shuffle=False)
+            if full_val
+            else ShardedSampler(
+                len(val_ds),
+                num_shards=derived.num_processes,
+                shard_index=derived.process_index,
+                shuffle=False,
+            )
         ),
-        num_workers=cfg.workers,
+        num_workers=derived.workers_per_device * derived.local_device_count,
     )
+    val_count_divisor = derived.num_processes if full_val else 1
     steps_per_epoch = max(len(train_loader), 1)
 
     compute_dtype = jnp.bfloat16 if derived.use_bf16 else jnp.float32
@@ -159,6 +177,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         schedule = make_step_decay_schedule(derived.scaled_lr, steps_per_epoch)
     tx = make_optimizer(cfg.momentum, cfg.weight_decay)
     rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
+    pretrained_vars = None
+    if cfg.pretrained:
+        # converted-torchvision weights (imagenet_ddp.py:109-111); see
+        # dptpu/models/pretrained.py for the offline conversion workflow
+        from dptpu.models.pretrained import load_pretrained_variables
+
+        pretrained_vars = load_pretrained_variables(
+            cfg.arch, model, input_shape=(1, image_size, image_size, 3)
+        )
+        if verbose:
+            print(f"=> using pre-trained model '{cfg.arch}'")
     state = create_train_state(
         rng,
         model,
@@ -167,6 +196,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # --start-epoch without --resume still lands on the reference's
         # epoch-N learning rate (the schedule reads the global step)
         initial_step=cfg.start_epoch * steps_per_epoch,
+        variables=pretrained_vars,
     )
 
     import os
@@ -184,7 +214,10 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if verbose:
                 print(f"=> no checkpoint found at '{cfg.resume}'")
 
-    train_step = make_train_step(mesh, compute_dtype, lr_schedule=schedule)
+    train_step = make_train_step(
+        mesh, compute_dtype, lr_schedule=schedule,
+        seed=cfg.seed if cfg.seed is not None else 0,
+    )
     eval_step = make_eval_step(mesh, compute_dtype)
 
     if cfg.evaluate:
@@ -195,6 +228,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             num_batches=len(val_loader),
             print_freq=cfg.print_freq,
             verbose=verbose,
+            count_divisor=val_count_divisor,
         )
         train_loader.close()
         val_loader.close()
@@ -249,6 +283,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             num_batches=len(val_loader),
             print_freq=cfg.print_freq,
             verbose=verbose,
+            count_divisor=val_count_divisor,
         )
         acc1 = val_stats["top1"]
         is_best = acc1 > best_acc1

@@ -1,0 +1,93 @@
+"""CLI-surface tests for the distributed flags and val-mode split.
+
+The reference CLIs accept CUDA-era distributed flags (``--dist-backend
+nccl``, ``--multiprocessing-distributed``, ``--dist-url``); BASELINE.json
+requires them to run unchanged. README documents the mapping: the backend
+string is accepted and recorded, rendezvous/collectives always go through
+jax.distributed + XLA collectives, and the mp.spawn ladder collapses into
+one process per host. These tests drive the real argparse schemas
+(dptpu.config.parse_config — the same object the root shims call) into
+fit() on the fake pod.
+"""
+
+import numpy as np
+import pytest
+
+from dptpu.config import parse_config
+from dptpu.train import fit
+
+
+def test_ddp_cli_distributed_flags_parse_and_map():
+    cfg = parse_config(
+        ["synthetic:48", "-a", "resnet18", "--dist-backend", "nccl",
+         "--dist-url", "tcp://224.66.41.62:23456", "--world-size", "1",
+         "--rank", "0", "-b", "16", "--epochs", "1"],
+        variant="ddp",
+    )
+    # accepted + recorded, exactly as typed (imagenet_ddp.py:61-65)
+    assert cfg.dist_backend == "nccl"
+    assert cfg.dist_url == "tcp://224.66.41.62:23456"
+    assert cfg.world_size == 1 and cfg.rank == 0
+
+
+def test_nd_cli_multiprocessing_distributed_parses():
+    cfg = parse_config(
+        ["synthetic:48", "-a", "resnet18", "--multiprocessing-distributed",
+         "-b", "16", "--epochs", "1"],
+        variant="nd",
+    )
+    assert cfg.multiprocessing_distributed is True
+
+
+@pytest.mark.parametrize("variant,extra", [
+    ("ddp", ["--dist-backend", "nccl"]),
+    ("nd", ["--multiprocessing-distributed"]),
+])
+def test_distributed_flags_train_end_to_end(variant, extra, tmp_path,
+                                            monkeypatch):
+    """The documented behavior: CUDA-specific flags never crash; training
+    proceeds through the mesh/jit path (SURVEY.md §7 hard part (e))."""
+    monkeypatch.chdir(tmp_path)
+    cfg = parse_config(
+        ["synthetic:48", "-a", "resnet18", "-b", "16", "--epochs", "1",
+         "-j", "2", "--lr", "0.01", *extra],
+        variant=variant,
+    )
+    result = fit(cfg, image_size=32, verbose=False)
+    assert result["epochs_run"] == 1
+    assert np.isfinite(result["history"][0]["train_loss"])
+
+
+def test_full_val_mode_counts_once_per_dataset(tmp_path, monkeypatch):
+    """ddp/nd report count == len(val) in full-val mode (single host), the
+    imagenet_ddp.py:186-194 behavior; apex's sharded val reports the same
+    by exact psum aggregation."""
+    monkeypatch.chdir(tmp_path)
+    counts = {}
+    for variant in ("ddp", "apex"):
+        cfg = parse_config(
+            ["synthetic:48", "-a", "resnet18", "-b", "16", "--epochs", "1",
+             "--lr", "0.01"],
+            variant=variant,
+        )
+        if variant == "apex":
+            cfg = cfg.replace(dist_url="env://")
+        result = fit(cfg, image_size=32, verbose=False)
+        counts[variant] = result["history"][0]["val_count"]
+    # synthetic val set = 48 // 10 = 4 samples; both modes count each once
+    assert counts["ddp"] == counts["apex"]
+
+
+def test_dropout_arch_trains_on_mesh(tmp_path, monkeypatch):
+    """Dropout models (alexnet/vgg heads, squeezenet) need the train step
+    to supply a dropout rng — regression for the per-step
+    fold_in(PRNGKey(seed), step) + per-shard axis fold plumbing."""
+    monkeypatch.chdir(tmp_path)
+    cfg = parse_config(
+        ["synthetic:48", "-a", "squeezenet1_1", "-b", "16", "--epochs", "1",
+         "--lr", "0.001", "--seed", "7"],
+        variant="nd",
+    )
+    result = fit(cfg, image_size=64, verbose=False)
+    assert result["epochs_run"] == 1
+    assert np.isfinite(result["history"][0]["train_loss"])

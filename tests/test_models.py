@@ -27,6 +27,12 @@ TORCHVISION_PARAM_COUNTS = {
     "vgg16_bn": 138_365_992,
     "vgg19": 143_667_240,
     "vgg19_bn": 143_678_248,
+    "densenet121": 7_978_856,
+    "densenet161": 28_681_000,
+    "densenet169": 14_149_480,
+    "densenet201": 20_013_928,
+    "squeezenet1_0": 1_248_424,
+    "squeezenet1_1": 1_235_496,
 }
 
 
@@ -65,15 +71,58 @@ def test_vgg_param_counts(name):
     assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
 
 
+@pytest.mark.parametrize("name", ["densenet121"])
+def test_densenet_param_counts(name):
+    _, variables = _init(name)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+@pytest.mark.parametrize("name", ["densenet161", "densenet169", "densenet201"])
+def test_densenet_param_counts_slow(name):
+    _, variables = _init(name)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+@pytest.mark.parametrize("name", ["squeezenet1_0", "squeezenet1_1"])
+def test_squeezenet_param_counts(name):
+    # squeezenet's unpadded stem conv + ceil-mode pools need >= 224 inputs
+    _, variables = _init(name, image=224)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+def test_squeezenet_ceil_mode_pool_shapes():
+    """torchvision squeezenet1_0 feature map is 13x13 at 224 input; the
+    ceil-mode pools are what make the 54 -> 27 -> 13 chain work."""
+    m = create_model("squeezenet1_0", num_classes=10)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
+    out = m.apply(v, jnp.zeros((2, 224, 224, 3)), train=False)
+    assert out.shape == (2, 10)
+
+
+def test_densenet_forward_and_bn_state():
+    m = create_model("densenet121", num_classes=5)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    assert "batch_stats" in v  # DenseNet is BN-heavy
+    out, mutated = m.apply(
+        v, jnp.ones((2, 64, 64, 3)), train=True, mutable=["batch_stats"]
+    )
+    assert out.shape == (2, 5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_registry_surface():
     names = model_names()
     assert names == sorted(names)
-    for required in ("resnet18", "resnet50", "resnet152", "alexnet", "vgg16"):
+    for required in ("resnet18", "resnet50", "resnet152", "alexnet", "vgg16",
+                     "densenet121", "densenet201", "squeezenet1_0",
+                     "squeezenet1_1"):
         assert required in names
 
 
-def test_pretrained_flag_raises():
-    with pytest.raises(RuntimeError, match="pretrained"):
+def test_pretrained_without_weights_fails_fast(monkeypatch, tmp_path):
+    # no converted weights anywhere -> actionable error naming the converter
+    monkeypatch.setenv("DPTPU_PRETRAINED_DIR", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="convert_torchvision"):
         create_model("resnet50", pretrained=True)
 
 
