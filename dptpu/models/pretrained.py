@@ -1,0 +1,263 @@
+"""Pretrained-weight loading: torchvision state dicts -> dptpu variables.
+
+The reference exposes ``--pretrained`` by constructing
+``models.__dict__[arch](pretrained=True)`` (imagenet_ddp.py:30-31,109-111),
+which downloads torchvision weights. This environment has no network, so
+dptpu splits the feature into two halves:
+
+* an **offline converter** (``python -m dptpu.tools.convert_torchvision``)
+  that reads a torchvision checkpoint (``.pth`` via torch's CPU unpickler,
+  or an ``.npz`` of numpy arrays keyed by torch names) and writes
+  ``<dir>/<arch>.npz`` in dptpu's native layout;
+* a **runtime loader** with zero torch dependency: ``--pretrained`` finds
+  ``<arch>.npz`` under ``$DPTPU_PRETRAINED_DIR`` (default ``./pretrained``)
+  and initializes the train state from it.
+
+Key mapping covers every in-tree family. dptpu module names intentionally
+mirror torchvision's (``features_3`` <-> ``features.3``,
+``layer1_block0`` <-> ``layer1.0``), so the map is mechanical:
+
+=========== ==========================  =============================
+collection  dptpu leaf                  torch leaf
+=========== ==========================  =============================
+params      ``kernel`` (conv, HWIO)     ``weight`` (OIHW, transposed)
+params      ``kernel`` (dense, IO)      ``weight`` (OI, transposed)
+params      ``scale`` (BN)              ``weight``
+params      ``bias``                    ``bias``
+batch_stats ``mean`` / ``var``          ``running_mean`` / ``running_var``
+=========== ==========================  =============================
+
+``num_batches_tracked`` buffers are dropped (dptpu's schedules are pure
+functions of the global step).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+import jax
+import numpy as np
+
+_LEAF_TO_TORCH = {
+    "kernel": "weight",
+    "scale": "weight",
+    "bias": "bias",
+    "mean": "running_mean",
+    "var": "running_var",
+}
+
+# torchvision squeezenet Sequential indices of fire modules, per version
+_SQUEEZE_FIRE_IDX = {
+    "1_0": {2: 3, 3: 4, 4: 5, 5: 7, 6: 8, 7: 9, 8: 10, 9: 12},
+    "1_1": {2: 3, 3: 4, 4: 6, 5: 7, 6: 9, 7: 10, 8: 11, 9: 12},
+}
+
+
+def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
+    """Map a dptpu module path (tuple of names) to the torch module path."""
+    head = mod[0]
+    if arch.startswith("resnet"):
+        if head.startswith("layer"):
+            layer, block = head.split("_block")
+            sub = {"downsample_conv": "downsample.0",
+                   "downsample_bn": "downsample.1"}.get(mod[1], mod[1])
+            return f"{layer}.{block}.{sub}"
+        return head  # conv1 / bn1 / fc
+    if arch == "alexnet" or arch.startswith("vgg"):
+        prefix, idx = head.rsplit("_", 1)
+        return f"{prefix}.{idx}"
+    if arch.startswith("densenet"):
+        if head in ("conv0", "norm0", "norm5"):
+            return f"features.{head}"
+        if head.startswith("denseblock"):
+            block, layer = head.split("_layer")
+            return f"features.{block}.denselayer{layer}.{mod[1]}"
+        if head.startswith("transition"):
+            return f"features.{head}.{mod[1]}"
+        return head  # classifier
+    if arch.startswith("squeezenet"):
+        version = arch.split("squeezenet")[1]
+        if head == "conv1":
+            return "features.0"
+        if head.startswith("fire"):
+            idx = _SQUEEZE_FIRE_IDX[version][int(head[4:])]
+            return f"features.{idx}.{mod[1]}"
+        return "classifier.1"  # final_conv
+    raise ValueError(f"no torchvision key mapping for arch {arch!r}")
+
+
+def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...], str]]:
+    """``{torch_key: (collection, dptpu_path, kind)}`` for every leaf.
+
+    ``kind`` is ``conv`` (4-D kernel, needs OIHW->HWIO), ``dense`` (2-D
+    kernel, needs OI->IO) or ``direct``.
+    """
+    out = {}
+    for collection in ("params", "batch_stats"):
+        tree = variables.get(collection, {})
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in flat:
+            names = tuple(p.key for p in path)
+            tmod = _torch_module(arch, names[:-1])
+            tleaf = _LEAF_TO_TORCH[names[-1]]
+            if names[-1] == "kernel":
+                kind = "conv" if leaf.ndim == 4 else "dense"
+            else:
+                kind = "direct"
+            key = f"{tmod}.{tleaf}"
+            assert key not in out, f"duplicate torch key {key}"
+            out[key] = (collection, names, kind)
+    return out
+
+
+def _from_torch(arr: np.ndarray, kind: str) -> np.ndarray:
+    arr = np.asarray(arr)
+    if kind == "conv":
+        return np.transpose(arr, (2, 3, 1, 0))  # OIHW -> HWIO
+    if kind == "dense":
+        return np.transpose(arr, (1, 0))  # OI -> IO
+    return arr
+
+
+def _to_torch(arr: np.ndarray, kind: str) -> np.ndarray:
+    arr = np.asarray(arr)
+    if kind == "conv":
+        return np.transpose(arr, (3, 2, 0, 1))  # HWIO -> OIHW
+    if kind == "dense":
+        return np.transpose(arr, (1, 0))
+    return arr
+
+
+def convert_state_dict(arch: str, state_dict: Dict[str, np.ndarray],
+                       template_variables):
+    """torch-keyed arrays -> dptpu ``{"params", "batch_stats"}`` variables.
+
+    ``template_variables`` (from ``model.init``) fixes the tree structure
+    and validates shapes. Raises on missing or mismatched keys so a wrong
+    checkpoint fails loudly rather than half-loading.
+    """
+    kmap = torch_key_map(arch, template_variables)
+    out = {"params": {}, "batch_stats": {}}
+
+    def set_path(tree, names, value):
+        for n in names[:-1]:
+            tree = tree.setdefault(n, {})
+        tree[names[-1]] = value
+
+    missing = [k for k in kmap if k not in state_dict]
+    if missing:
+        raise KeyError(
+            f"state dict for {arch} is missing {len(missing)} keys, e.g. "
+            f"{missing[:3]}"
+        )
+    flat_template = {
+        (c, names): leaf
+        for c in ("params", "batch_stats")
+        for names, leaf in (
+            (tuple(p.key for p in path), leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                template_variables.get(c, {}))[0]
+        )
+    }
+    for key, (collection, names, kind) in kmap.items():
+        arr = _from_torch(state_dict[key], kind).astype(np.float32)
+        want = flat_template[(collection, names)].shape
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"{key}: converted shape {arr.shape} != expected {want}"
+            )
+        set_path(out[collection], names, arr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# npz round trip + runtime resolution
+# ---------------------------------------------------------------------------
+
+def save_npz(path: str, variables) -> None:
+    flat = {}
+    for collection in ("params", "batch_stats"):
+        for p, leaf in jax.tree_util.tree_flatten_with_path(
+                variables.get(collection, {}))[0]:
+            key = collection + "/" + "/".join(k.key for k in p)
+            flat[key] = np.asarray(leaf)
+    np.savez(path, **flat)
+
+
+def load_npz(path: str):
+    out = {"params": {}, "batch_stats": {}}
+    with np.load(path) as data:
+        for key in data.files:
+            collection, *names = key.split("/")
+            tree = out[collection]
+            for n in names[:-1]:
+                tree = tree.setdefault(n, {})
+            tree[names[-1]] = data[key]
+    return out
+
+
+def weights_search_dirs():
+    env = os.environ.get("DPTPU_PRETRAINED_DIR")
+    return [env] if env else ["pretrained", "."]
+
+
+def find_weights(arch: str):
+    """Resolve ``<arch>.npz``; None if absent."""
+    for d in weights_search_dirs():
+        p = os.path.join(d, f"{arch}.npz")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def require_weights(arch: str) -> str:
+    """``find_weights`` or raise the one canonical instructions error."""
+    path = find_weights(arch)
+    if path is None:
+        raise FileNotFoundError(
+            f"--pretrained: no converted weights found for {arch!r} "
+            f"(searched {weights_search_dirs()} for {arch}.npz). Convert a "
+            f"torchvision checkpoint offline with: python -m "
+            f"dptpu.tools.convert_torchvision <ckpt.pth> -a {arch} -o "
+            f"pretrained/  (set DPTPU_PRETRAINED_DIR to use another "
+            f"directory)"
+        )
+    return path
+
+
+def load_pretrained_variables(arch: str, model, input_shape=(1, 224, 224, 3)):
+    """Load converted weights for ``arch`` and validate against ``model``.
+
+    The pytree structure must match the model's own ``init`` exactly
+    (num_classes mismatches surface as shape errors here, matching
+    torchvision's strict load semantics).
+    """
+    path = require_weights(arch)
+    loaded = load_npz(path)
+    template = model.init(
+        jax.random.PRNGKey(0), np.zeros(input_shape, np.float32), train=False
+    )
+    t_struct = jax.tree_util.tree_structure(
+        {"params": template["params"],
+         "batch_stats": template.get("batch_stats", {})}
+    )
+    l_struct = jax.tree_util.tree_structure(loaded)
+    if t_struct != l_struct:
+        raise ValueError(
+            f"{path} does not match the {arch} parameter tree "
+            f"(wrong arch or stale conversion?)"
+        )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(loaded)[0],
+        jax.tree_util.tree_flatten_with_path(
+            {"params": template["params"],
+             "batch_stats": template.get("batch_stats", {})})[0],
+    ):
+        if tuple(a.shape) != tuple(b.shape):
+            name = "/".join(str(k.key) for k in pa)
+            raise ValueError(
+                f"{path}: {name} has shape {a.shape}, model wants {b.shape} "
+                f"(num_classes mismatch?)"
+            )
+    return loaded

@@ -25,13 +25,19 @@ def model_names():
 
 
 def create_model(name, pretrained=False, **kwargs):
-    """``models.__dict__[arch](pretrained=...)`` analog (imagenet_ddp.py:108-114)."""
+    """``models.__dict__[arch](pretrained=...)`` analog (imagenet_ddp.py:108-114).
+
+    With ``pretrained=True`` the converted-weights file for ``name`` must
+    exist (``$DPTPU_PRETRAINED_DIR`` or ``./pretrained``); this validates
+    it up front so the CLI fails fast with conversion instructions. The
+    weights themselves are applied at init time via
+    ``dptpu.models.pretrained.load_pretrained_variables`` (flax modules
+    are stateless, so construction cannot carry them the way torch does).
+    """
     if name not in _REGISTRY:
         raise KeyError(f"unknown architecture {name!r}; choices: {model_names()}")
     if pretrained:
-        raise RuntimeError(
-            "--pretrained requires downloading torchvision weights, which is "
-            "unavailable in this environment; train from scratch or --resume "
-            "from a dptpu checkpoint instead"
-        )
+        from dptpu.models.pretrained import require_weights
+
+        require_weights(name)
     return _REGISTRY[name](**kwargs)

@@ -1,0 +1,79 @@
+"""Offline torchvision -> dptpu weight converter.
+
+Usage::
+
+    python -m dptpu.tools.convert_torchvision <checkpoint> -a resnet50 \
+        [-o pretrained/] [--num-classes 1000]
+
+``<checkpoint>`` is either a torchvision ``.pth``/``.pt`` state dict
+(read with torch's CPU unpickler — torch is only needed HERE, never at
+training time) or an ``.npz`` whose keys are the torch parameter names.
+Writes ``<out>/<arch>.npz`` in dptpu's native layout, which
+``--pretrained`` resolves at runtime (imagenet_ddp.py:109-111 semantics;
+see dptpu/models/pretrained.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def read_torch_state_dict(path: str):
+    if path.endswith(".npz"):
+        with np.load(path) as data:
+            return {k: data[k] for k in data.files}
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise SystemExit(
+            "reading .pth checkpoints needs torch (CPU build is enough); "
+            "alternatively convert to .npz with torch-name keys elsewhere"
+        ) from e
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if "state_dict" in obj and all(
+        hasattr(v, "numpy") for v in obj["state_dict"].values()
+    ):
+        obj = obj["state_dict"]
+    return {
+        k.removeprefix("module."): v.numpy()
+        for k, v in obj.items()
+        if hasattr(v, "numpy")
+    }
+
+
+def main(argv=None):
+    from dptpu.models import create_model, model_names
+    from dptpu.models.pretrained import convert_state_dict, save_npz
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint")
+    p.add_argument("-a", "--arch", required=True, choices=model_names())
+    p.add_argument("-o", "--out-dir", default="pretrained")
+    p.add_argument("--num-classes", default=1000, type=int)
+    args = p.parse_args(argv)
+
+    import jax
+
+    model = create_model(args.arch, num_classes=args.num_classes)
+    template = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 224, 224, 3), np.float32),
+        train=False,
+    )
+    sd = read_torch_state_dict(args.checkpoint)
+    variables = convert_state_dict(args.arch, sd, template)
+    os.makedirs(args.out_dir, exist_ok=True)
+    out = os.path.join(args.out_dir, f"{args.arch}.npz")
+    save_npz(out, variables)
+    n = sum(x.size for x in jax.tree_util.tree_leaves(variables))
+    print(f"wrote {out} ({n:,} parameters + stats)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,14 +54,23 @@ def create_train_state(
     input_shape=(1, 224, 224, 3),
     input_dtype=jnp.float32,
     initial_step: int = 0,
+    variables=None,
 ) -> TrainState:
     """Initialize params/BN state with a dummy batch and build the state.
 
     ``initial_step`` seeds the global step for fresh runs that start at a
     later epoch (``--start-epoch`` without ``--resume``,
     imagenet_ddp.py:35-36): the LR schedule reads this step.
+
+    ``variables`` overrides the random init with an existing
+    ``{"params", "batch_stats"}`` tree — the ``--pretrained`` path
+    (imagenet_ddp.py:109-111), fed by
+    ``dptpu.models.pretrained.load_pretrained_variables``.
     """
-    variables = model.init(rng, jnp.zeros(input_shape, input_dtype), train=False)
+    if variables is None:
+        variables = model.init(
+            rng, jnp.zeros(input_shape, input_dtype), train=False
+        )
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return TrainState(

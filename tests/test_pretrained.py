@@ -1,0 +1,180 @@
+"""--pretrained: torchvision-state-dict conversion + runtime loading.
+
+The reference builds ``models.__dict__[arch](pretrained=True)``
+(imagenet_ddp.py:109-111); dptpu splits that into an offline converter and
+a torch-free runtime loader (dptpu/models/pretrained.py). These tests
+round-trip synthetic torch-keyed weights through the full pipeline.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dptpu.models import create_model
+from dptpu.models.pretrained import (
+    _to_torch,
+    convert_state_dict,
+    find_weights,
+    load_npz,
+    load_pretrained_variables,
+    save_npz,
+    torch_key_map,
+)
+
+
+def _init_vars(arch, num_classes=10, image=None):
+    if image is None:
+        # vgg/alexnet/squeezenet need full-size inputs (fixed-grid pools)
+        image = 32 if arch.startswith(("resnet", "densenet")) else 224
+    model = create_model(arch, num_classes=num_classes)
+    v = model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, image, image, 3)), train=False)
+    return model, {"params": v["params"],
+                   "batch_stats": v.get("batch_stats", {})}
+
+
+def _fake_torch_sd(arch, variables, rng):
+    """Synthetic torch-keyed state dict with the right (torch) layouts."""
+    sd = {}
+    flat = {
+        (c, tuple(p.key for p in path)): leaf
+        for c in ("params", "batch_stats")
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            variables.get(c, {}))[0]
+    }
+    for key, (collection, names, kind) in torch_key_map(arch, variables).items():
+        shape = flat[(collection, names)].shape
+        if key.endswith("running_var"):
+            arr = (rng.rand(*shape) + 0.5).astype(np.float32)  # positive
+        else:
+            # small values so eval through 18+ layers stays finite
+            arr = (rng.randn(*shape) * 0.05).astype(np.float32)
+        sd[key] = _to_torch(arr, kind)
+    return sd
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "alexnet", "densenet121",
+                                  "squeezenet1_0", "vgg11_bn"])
+def test_key_map_unique_and_torch_shaped(arch):
+    _, v = _init_vars(arch)
+    kmap = torch_key_map(arch, v)
+    n_leaves = sum(
+        len(jax.tree_util.tree_leaves(v[c])) for c in ("params", "batch_stats")
+    )
+    assert len(kmap) == n_leaves  # every leaf mapped, no collisions
+
+
+def test_key_map_matches_known_torchvision_names():
+    _, v = _init_vars("resnet50")
+    keys = torch_key_map("resnet50", v)
+    for k in ("conv1.weight", "bn1.running_mean", "layer1.0.downsample.0.weight",
+              "layer1.0.downsample.1.weight", "layer4.2.conv3.weight",
+              "fc.weight", "fc.bias"):
+        assert k in keys, k
+    _, v = _init_vars("densenet121")
+    keys = torch_key_map("densenet121", v)
+    for k in ("features.conv0.weight", "features.norm5.bias",
+              "features.denseblock1.denselayer1.norm1.weight",
+              "features.denseblock4.denselayer16.conv2.weight",
+              "features.transition1.conv.weight", "classifier.weight"):
+        assert k in keys, k
+    _, v = _init_vars("squeezenet1_0", image=224)
+    keys = torch_key_map("squeezenet1_0", v)
+    for k in ("features.0.weight", "features.3.squeeze.weight",
+              "features.12.expand3x3.bias", "classifier.1.weight"):
+        assert k in keys, k
+    _, v = _init_vars("alexnet", image=224)
+    keys = torch_key_map("alexnet", v)
+    assert "features.0.weight" in keys and "classifier.6.bias" in keys
+
+
+def test_convert_round_trip_resnet18():
+    """torch layouts (OIHW / OI) convert back to exactly the dptpu tree."""
+    rng = np.random.RandomState(0)
+    model, template = _init_vars("resnet18")
+    sd = _fake_torch_sd("resnet18", template, rng)
+    converted = convert_state_dict("resnet18", sd, template)
+    # structure identical
+    assert (jax.tree_util.tree_structure(converted)
+            == jax.tree_util.tree_structure(template))
+    # conv kernels really were transposed, not just reshaped
+    k = converted["params"]["conv1"]["kernel"]
+    np.testing.assert_array_equal(
+        np.transpose(sd["conv1.weight"], (2, 3, 1, 0)), k
+    )
+    # and the model runs with them
+    out = model.apply(converted, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10) and np.isfinite(np.asarray(out)).all()
+
+
+def test_convert_rejects_missing_and_mismatched():
+    rng = np.random.RandomState(1)
+    _, template = _init_vars("resnet18")
+    sd = _fake_torch_sd("resnet18", template, rng)
+    bad = dict(sd)
+    bad.pop("fc.bias")
+    with pytest.raises(KeyError, match="missing"):
+        convert_state_dict("resnet18", bad, template)
+    bad = dict(sd)
+    bad["fc.weight"] = bad["fc.weight"][:, :3]
+    with pytest.raises(ValueError, match="shape"):
+        convert_state_dict("resnet18", bad, template)
+
+
+def test_npz_round_trip_and_runtime_load(tmp_path, monkeypatch):
+    rng = np.random.RandomState(2)
+    model, template = _init_vars("resnet18")
+    sd = _fake_torch_sd("resnet18", template, rng)
+    converted = convert_state_dict("resnet18", sd, template)
+    save_npz(str(tmp_path / "resnet18.npz"), converted)
+    monkeypatch.setenv("DPTPU_PRETRAINED_DIR", str(tmp_path))
+    assert find_weights("resnet18") == str(tmp_path / "resnet18.npz")
+
+    loaded = load_pretrained_variables(
+        "resnet18", model, input_shape=(1, 32, 32, 3)
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(loaded),
+                    jax.tree_util.tree_leaves(converted)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # wrong num_classes -> loud shape error
+    model5 = create_model("resnet18", num_classes=5)
+    with pytest.raises(ValueError, match="num_classes|shape"):
+        load_pretrained_variables("resnet18", model5, input_shape=(1, 32, 32, 3))
+
+
+def test_create_model_pretrained_gate(tmp_path, monkeypatch):
+    monkeypatch.setenv("DPTPU_PRETRAINED_DIR", str(tmp_path / "nope"))
+    with pytest.raises(FileNotFoundError, match="convert_torchvision"):
+        create_model("resnet18", pretrained=True)
+    # with the file present, construction succeeds
+    rng = np.random.RandomState(3)
+    model, template = _init_vars("resnet18")
+    sd = _fake_torch_sd("resnet18", template, rng)
+    converted = convert_state_dict("resnet18", sd, template)
+    d = tmp_path / "weights"
+    d.mkdir()
+    save_npz(str(d / "resnet18.npz"), converted)
+    monkeypatch.setenv("DPTPU_PRETRAINED_DIR", str(d))
+    assert create_model("resnet18", pretrained=True) is not None
+
+
+def test_converter_cli_npz_input(tmp_path, monkeypatch):
+    """The CLI converter accepts a torch-keyed .npz (no torch needed)."""
+    from dptpu.tools.convert_torchvision import main
+
+    rng = np.random.RandomState(4)
+    model = create_model("resnet18")  # default 1000 classes, 224 input
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                   train=False)
+    template = {"params": v["params"], "batch_stats": v["batch_stats"]}
+    sd = _fake_torch_sd("resnet18", template, rng)
+    np.savez(tmp_path / "raw.npz", **sd)
+    out_dir = tmp_path / "out"
+    assert main([str(tmp_path / "raw.npz"), "-a", "resnet18",
+                 "-o", str(out_dir)]) == 0
+    loaded = load_npz(str(out_dir / "resnet18.npz"))
+    assert "conv1" in loaded["params"]
