@@ -199,11 +199,14 @@ class DerivedConfig:
     per_device_batch_size: int
     global_batch_size: int
     per_host_batch_size: int
+    # ceil(workers / local devices), imagenet_ddp.py:126 — one host
+    # process drives all local chips, so its loader runs
+    # workers_per_device * local_device_count decode threads (the sum of
+    # what the reference's per-GPU DataLoaders would spawn)
     workers_per_device: int
     scaled_lr: float
     use_bf16: bool
     sync_bn: bool
-    distributed: bool
 
     @property
     def is_chief(self) -> bool:
@@ -234,12 +237,12 @@ def derive(cfg: Config, *, local_device_count: int,
     if cfg.variant == "apex":
         scaled_lr = cfg.lr * float(global_batch) / 256.0
 
-    distributed = (
-        num_processes > 1
-        or cfg.world_size > 1
-        or cfg.multiprocessing_distributed
-        or int(os.environ.get("WORLD_SIZE", "1")) > 1
-    )
+    # NOTE: the reference's ``args.distributed`` switch (DDP vs
+    # DataParallel vs single device, nd_imagenet.py:101,140-169) has no
+    # derived field here BY DESIGN: the rendezvous decision lives in
+    # ``initialize_distributed`` (which reads the config directly, before
+    # jax process info exists) and the placement ladder collapses into
+    # "mesh over however many devices there are".
     return DerivedConfig(
         num_processes=num_processes,
         process_index=process_index,
@@ -252,5 +255,4 @@ def derive(cfg: Config, *, local_device_count: int,
         scaled_lr=scaled_lr,
         use_bf16=use_bf16,
         sync_bn=cfg.sync_bn,
-        distributed=distributed,
     )

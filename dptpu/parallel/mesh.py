@@ -29,15 +29,47 @@ MODEL_AXIS = "model"
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[dict] = None,
+    hierarchical: Optional[bool] = None,
 ) -> Mesh:
     """Build the global device mesh.
 
     ``mesh_shape`` maps axis name → size, in axis order; ``-1`` means "all
     remaining devices". Default: ``{"data": -1}`` — every chip on the data
     axis, the DDP-equivalent topology.
+
+    ``hierarchical`` (default: auto — on whenever the devices span more
+    than one process) orders the device array **host-major**: every host's
+    chips form a contiguous block along the outermost (first) axis, with
+    any inner axes (e.g. ``model``) living entirely inside one host. This
+    is the (DCN, ICI) factored layout for multi-host pods — XLA decomposes
+    the data-axis all-reduce into a fast intra-host ICI reduce followed by
+    a small cross-host DCN exchange, instead of ring-reducing over DCN at
+    ICI granularity. The v5p-32/128 BASELINE configs (4/16 hosts) depend
+    on this. Counterpart of the reference's node-major rank layout
+    (``rank = node_rank * ngpus_per_node + gpu``, imagenet_ddp.py:103),
+    which gives NCCL the same hierarchy.
     """
     if devices is None:
         devices = jax.devices()
+    devices = list(devices)
+    n_procs = len({getattr(d, "process_index", 0) for d in devices})
+    if hierarchical is None:
+        hierarchical = n_procs > 1
+    if hierarchical:
+        per_host: dict = {}
+        for d in devices:
+            per_host.setdefault(getattr(d, "process_index", 0), []).append(d)
+        counts = {len(v) for v in per_host.values()}
+        if len(counts) != 1:
+            raise ValueError(
+                f"hierarchical mesh needs equal chips per host, got "
+                f"{ {k: len(v) for k, v in per_host.items()} }"
+            )
+        devices = [
+            d
+            for proc in sorted(per_host)
+            for d in sorted(per_host[proc], key=lambda d: getattr(d, "id", 0))
+        ]
     devices = np.asarray(devices)
     if mesh_shape is None:
         mesh_shape = {DATA_AXIS: -1}
@@ -49,6 +81,12 @@ def make_mesh(
         sizes[sizes.index(-1)] = n // known
     if int(np.prod(sizes)) != n:
         raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
+    if hierarchical and int(np.prod(sizes[1:])) > n // n_procs:
+        raise ValueError(
+            f"hierarchical mesh: inner axes {dict(zip(names[1:], sizes[1:]))} "
+            f"exceed one host's {n // n_procs} chips — inner-axis collectives "
+            f"would cross DCN"
+        )
     return Mesh(devices.reshape(sizes), names)
 
 

@@ -106,10 +106,17 @@ def validate(
     num_batches: int,
     print_freq: int = 10,
     verbose: bool = True,
+    count_divisor: int = 1,
 ):
     """Full validation pass; returns ``{top1, top5, loss, count}`` with exact
     global aggregation (sharded val + psum — the Apex behavior,
-    imagenet_ddp_apex.py:232-234,457-460 — with a single final sync)."""
+    imagenet_ddp_apex.py:232-234,457-460 — with a single final sync).
+
+    ``count_divisor``: in full-val-on-every-rank mode (ddp/nd,
+    imagenet_ddp.py:186-194) every host feeds the full val set, so the
+    psum counts each sample once per host; the averages are unaffected
+    (numerator and denominator scale together) and the divisor restores
+    the true sample count in the report."""
     batch_time = AverageMeter("Time", ":6.3f", Summary.NONE)
     progress = ProgressMeter(num_batches, [batch_time], prefix="Test: ")
 
@@ -130,7 +137,7 @@ def validate(
         "top1": 100.0 * totals["correct1"] / count,
         "top5": 100.0 * totals["correct5"] / count,
         "loss": totals["loss_sum"] / count,
-        "count": totals["count"],
+        "count": totals["count"] / count_divisor,
         "batch_time": batch_time.avg,
     }
     if verbose:

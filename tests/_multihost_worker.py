@@ -62,6 +62,11 @@ def main():
             return nn.Dense(4)(x)
 
     mesh = make_mesh()
+    # hierarchical (DCN, ICI) layout auto-engages with >1 process: each
+    # host's chips must form a contiguous block along the data axis
+    arr = mesh.devices.reshape(-1)
+    procs = [d.process_index for d in arr]
+    assert procs == sorted(procs), f"mesh not host-major: {procs}"
     tx = make_optimizer(0.9, 1e-4)
     state = create_train_state(
         jax.random.PRNGKey(0), Tiny(), tx, input_shape=(1, 8, 8, 3)

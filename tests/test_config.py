@@ -80,7 +80,6 @@ def test_derive_ddp_batch_split():
     assert d.global_batch_size == 4096
     assert d.workers_per_device == 1  # ceil(4/4)
     assert not d.is_chief
-    assert d.distributed
 
 
 def test_derive_apex_per_device_batch_and_lr_scaling():
@@ -103,4 +102,4 @@ def test_derive_single_device():
     d = derive(cfg, local_device_count=1)
     assert d.per_device_batch_size == 256
     assert d.global_batch_size == 256
-    assert d.is_chief and not d.distributed
+    assert d.is_chief

@@ -60,3 +60,54 @@ def test_two_process_training_agrees(tmp_path):
     # single-writer guard: only rank 0 checkpoints
     assert (tmp_path / "ckpt_rank0.pth.tar").exists()
     assert not (tmp_path / "ckpt_rank1.pth.tar").exists()
+
+
+class _FakeDev:
+    """Stand-in with the attributes make_mesh reads."""
+
+    def __init__(self, id, process_index):
+        self.id = id
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"d{self.id}@p{self.process_index}"
+
+
+def test_hierarchical_mesh_orders_hosts_contiguously():
+    """(DCN, ICI) factoring: the v5p-32 layout (4 hosts x 4 chips) must put
+    each host's chips in one contiguous block of the data axis, whatever
+    order the platform enumerates devices in."""
+    from dptpu.parallel.mesh import make_mesh
+
+    # interleaved enumeration (process-minor), the worst case
+    devs = [_FakeDev(id=h * 4 + c, process_index=h)
+            for c in range(4) for h in range(4)]
+    mesh = make_mesh(devices=devs, mesh_shape={"data": -1})
+    flat = list(mesh.devices.reshape(-1))
+    assert [d.process_index for d in flat] == sorted(
+        d.process_index for d in flat
+    )
+    # within a host, stable by device id
+    assert [d.id for d in flat if d.process_index == 2] == [8, 9, 10, 11]
+
+
+def test_hierarchical_mesh_keeps_model_axis_on_one_host():
+    from dptpu.parallel.mesh import make_mesh
+
+    devs = [_FakeDev(id=h * 4 + c, process_index=h)
+            for h in range(4) for c in range(4)]
+    mesh = make_mesh(devices=devs, mesh_shape={"data": -1, "model": 4})
+    # every row of the (data, model) grid lives on a single host
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1
+    # a model axis wider than a host must be refused, not silently slow
+    with pytest.raises(ValueError, match="inner axes"):
+        make_mesh(devices=devs, mesh_shape={"data": -1, "model": 8})
+
+
+def test_hierarchical_mesh_rejects_ragged_hosts():
+    from dptpu.parallel.mesh import make_mesh
+
+    devs = [_FakeDev(0, 0), _FakeDev(1, 0), _FakeDev(2, 1)]
+    with pytest.raises(ValueError, match="equal chips"):
+        make_mesh(devices=devs)
