@@ -85,10 +85,10 @@ def _fwd_xla(z, gamma_t, beta_t):
     return jnp.maximum(pooled, 0.0).astype(z.dtype)
 
 
-def _bwd_xla(z, gamma_t, beta_t, y, g):
+def _bwd_xla(z, gamma_t, beta_t, g):
     """Reference backward (pure lax). Returns (dz, dgamma_t, dbeta_t)."""
     b, h, w, c = z.shape
-    oh, ow = y.shape[1], y.shape[2]
+    oh, ow = g.shape[1], g.shape[2]
     dt = z.dtype
 
     a = gamma_t.astype(jnp.float32) * z.astype(jnp.float32) \
@@ -237,8 +237,11 @@ def _bwd_kernel(z_ref, g_ref, gam_ref, bet_ref,
         gscr[:nreal, :oh, :] = g_ref[0, w0:w0 + nreal, :, :].astype(jnp.float32)
         graw = gscr[:nw, :oh, :]
         gm = jnp.where(best > 0, graw, 0.0)
-        dgam_ref[:] = dgam_ref[:] + (gm * zwin).sum(axis=(0, 1))
-        dbet_ref[:] = dbet_ref[:] + gm.sum(axis=(0, 1))
+        # affine grads sum over THIS chunk's ch owned window rows only —
+        # the +1 overlap row (needed by the di == 1 taps below) belongs to
+        # the next chunk, which sums it itself
+        dgam_ref[:] = dgam_ref[:] + (gm[:ch] * zwin[:ch]).sum(axis=(0, 1))
+        dbet_ref[:] = dbet_ref[:] + gm[:ch].sum(axis=(0, 1))
 
         # re-store the masked gradient + winner index with a zero/255 apron
         # so the four parity taps can read one row/col beyond the chunk
@@ -342,28 +345,45 @@ def _use_pallas(z):
 
 
 @partial(jax.custom_vjp)
-def affine_relu_pool(z, gamma_t, beta_t):
-    """maxpool_3x3s2p1(relu(gamma_t * z + beta_t)) with a fused backward.
-
-    ``z``: NHWC; ``gamma_t``/``beta_t``: per-channel affine. Requires even
-    square spatial dims for the Pallas path; falls back to pure-XLA ops
-    otherwise (identical semantics either way).
-    """
+def _affine_relu_pool_even(z, gamma_t, beta_t):
     if _use_pallas(z):
         return _fwd_pallas(z, gamma_t, beta_t)
     return _fwd_xla(z, gamma_t, beta_t)
 
 
 def _arp_fwd(z, gamma_t, beta_t):
-    y = affine_relu_pool(z, gamma_t, beta_t)
-    return y, (z, gamma_t, beta_t, y)
+    y = _affine_relu_pool_even(z, gamma_t, beta_t)
+    # y is NOT a residual: backward recomputes the window max (which also
+    # yields the relu mask), so the pooled activation can die after use
+    return y, (z, gamma_t, beta_t)
 
 
 def _arp_bwd(res, g):
-    z, gamma_t, beta_t, y = res
+    z, gamma_t, beta_t = res
     if _use_pallas(z):
         return _bwd_pallas(z, gamma_t, beta_t, g)
-    return _bwd_xla(z, gamma_t, beta_t, y, g)
+    return _bwd_xla(z, gamma_t, beta_t, g)
 
 
-affine_relu_pool.defvjp(_arp_fwd, _arp_bwd)
+_affine_relu_pool_even.defvjp(_arp_fwd, _arp_bwd)
+
+
+def affine_relu_pool(z, gamma_t, beta_t):
+    """maxpool_3x3s2p1(relu(gamma_t * z + beta_t)) with a fused backward.
+
+    ``z``: NHWC; ``gamma_t``/``beta_t``: per-channel affine. Even spatial
+    dims run the custom-VJP region (Pallas kernels on TPU when the shape
+    qualifies, pure-XLA reference otherwise — identical semantics). Odd
+    dims fall back to the plain composition, whose backward is XLA's own
+    select_and_scatter: the fused backward's parity interleave only
+    reconstructs 2*oh x 2*ow planes.
+    """
+    if z.shape[1] % 2 or z.shape[2] % 2:
+        a = gamma_t.astype(jnp.float32) * z.astype(jnp.float32) \
+            + beta_t.astype(jnp.float32)
+        pooled = lax.reduce_window(
+            a, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)),
+        )
+        return jnp.maximum(pooled, 0.0).astype(z.dtype)
+    return _affine_relu_pool_even(z, gamma_t, beta_t)

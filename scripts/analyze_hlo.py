@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Dump + analyze the compiled HLO of the bench train step.
+
+Counts op categories (copies, select_and_scatter, fusions) and buckets the
+copy ops by shape so the copy storm (PERF.md) can be attributed to real
+parameters rather than guessed at.
+"""
+
+import collections
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from dptpu.models import create_model
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    per_chip_batch = 128
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    step = make_train_step(
+        None, jnp.bfloat16, lr_schedule=make_step_decay_schedule(0.1, 100)
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": rng.randint(0, 256, (per_chip_batch, 224, 224, 3)).astype(
+            np.uint8
+        ),
+        "labels": rng.randint(0, 1000, (per_chip_batch,)).astype(np.int32),
+    }
+    compiled = step.lower(state, batch).compile()
+    text = compiled.as_text()
+    with open("/tmp/step_hlo.txt", "w") as f:
+        f.write(text)
+
+    ops = collections.Counter()
+    copy_shapes = collections.Counter()
+    for line in text.splitlines():
+        m = re.match(r"\s*(?:ROOT )?%?[\w.-]+ = (\S+?)\[([\d,]*)\][^ ]* (\w+)", line)
+        if not m:
+            continue
+        dtype, shape, opname = m.groups()
+        ops[opname] += 1
+        if opname in ("copy", "copy-start", "copy-done"):
+            copy_shapes[f"{dtype}[{shape}]"] += 1
+    print("== op counts (top 30) ==")
+    for op, n in ops.most_common(30):
+        print(f"  {op:30s} {n}")
+    print("== copy shapes ==")
+    for s, n in copy_shapes.most_common(40):
+        print(f"  {s:40s} {n}")
+    print("select_and_scatter lines:")
+    for line in text.splitlines():
+        if "select-and-scatter" in line:
+            print("  " + line.strip()[:200])
+    # memory analysis
+    mem = compiled.memory_analysis()
+    print("memory:", mem)
+
+
+if __name__ == "__main__":
+    main()

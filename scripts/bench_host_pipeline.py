@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Host input-pipeline benchmark: decoded + cropped images/sec.
+
+The reference's own known hard part is CPU-side decode/transform
+(imagenet_ddp_apex.py:215-226 "Too slow" — the reason fast_collate and
+DataPrefetcher exist). This measures dptpu's equivalents on
+ImageNet-shaped JPEGs (synthesized ~500x400 quality-85, the ImageNet
+median), across:
+
+* backend: native C++ fused decode-crop-resize (dptpu/native) vs PIL;
+* thread count: 1 / 4 / 8 / 16 (the DataLoader pool);
+* the full train transform (RandomResizedCrop 224 + flip).
+
+Plus an end-to-end DataLoader rate (decode + collate into pinned uint8
+batches) at the default worker count. Writes HOSTBENCH.json at the repo
+root and prints one line per config.
+
+Usage: python scripts/bench_host_pipeline.py [--images 512] [--seconds 6]
+"""
+
+import argparse
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_jpegs(n, tmpdir):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    paths = []
+    os.makedirs(tmpdir, exist_ok=True)
+    for i in range(n):
+        # textured content so JPEG size is realistic (~100 KB, not ~5)
+        low = rng.randint(0, 255, (50, 40, 3), np.uint8)
+        img = np.asarray(
+            Image.fromarray(low).resize((500, 400), Image.BILINEAR)
+        )
+        img = np.clip(
+            img.astype(np.int16) + rng.randint(-20, 20, img.shape), 0, 255
+        ).astype(np.uint8)
+        p = os.path.join(tmpdir, f"{i}.jpg")
+        Image.fromarray(img).save(p, quality=85)
+        paths.append(p)
+    return paths
+
+
+def bench_backend(root, use_native, n_threads, seconds):
+    """Images/s through the exact per-item path DataLoader runs
+    (ImageFolderDataset.get: native fused decode-crop-resize when
+    available, PIL otherwise)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dptpu.data import ImageFolderDataset, native_image, train_transform
+
+    ds = ImageFolderDataset(root, train_transform(224))
+    orig_available = native_image.available
+    if not use_native:
+        native_image.available = lambda: False
+    try:
+        def load_one(i):
+            rng = np.random.default_rng([0, 0, i])
+            return ds.get(i % len(ds), rng)
+
+        pool = ThreadPoolExecutor(max_workers=n_threads)
+        list(pool.map(load_one, range(2 * n_threads)))  # warmup
+        t0 = time.perf_counter()
+        done = 0
+        idx = 0
+        while time.perf_counter() - t0 < seconds:
+            chunk = list(range(idx, idx + 64))
+            idx += 64
+            for _ in pool.map(load_one, chunk):
+                done += 1
+        dt = time.perf_counter() - t0
+        pool.shutdown()
+    finally:
+        native_image.available = orig_available
+    return done / dt
+
+
+def bench_loader(root, n_workers, seconds):
+    from dptpu.data import DataLoader, ImageFolderDataset, train_transform
+
+    ds = ImageFolderDataset(root, train_transform(224))
+    loader = DataLoader(ds, 64, num_workers=n_workers, drop_last=True)
+    done, t0 = 0, time.perf_counter()
+    epoch = 0
+    while time.perf_counter() - t0 < seconds:
+        for b in loader.epoch(epoch):
+            done += b["images"].shape[0]
+            if time.perf_counter() - t0 > seconds:
+                break
+        epoch += 1
+    rate = done / (time.perf_counter() - t0)
+    loader.close()
+    return rate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=256)
+    ap.add_argument("--seconds", type=float, default=6.0)
+    ap.add_argument("--out", default="HOSTBENCH.json")
+    args = ap.parse_args()
+
+    import tempfile
+
+    from dptpu.data import native_image
+
+    tmp = tempfile.mkdtemp(prefix="dptpu_hostbench_")
+    cls = os.path.join(tmp, "train", "class0")
+    make_jpegs(args.images, cls)
+    have_native = native_image.available()
+
+    results = {"native_available": have_native, "jpeg": "500x400 q85",
+               "transform": "RandomResizedCrop(224)+flip",
+               "host_cpu_count": os.cpu_count(), "configs": []}
+    backends = [("native", True)] if have_native else []
+    backends.append(("pil", False))
+    for name, use_native in backends:
+        for threads in (1, 4, 8, 16):
+            rate = bench_backend(os.path.join(tmp, "train"), use_native,
+                                 threads, args.seconds)
+            results["configs"].append(
+                {"backend": name, "threads": threads,
+                 "images_per_sec": round(rate, 1)}
+            )
+            print(f"{name:7s} threads={threads:<3d} {rate:8.1f} img/s")
+
+    e2e = bench_loader(os.path.join(tmp, "train"), 8, args.seconds)
+    results["loader_e2e_8workers_imgs_per_sec"] = round(e2e, 1)
+    print(f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s")
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

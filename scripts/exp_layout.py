@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Experiment: AUTO entry layouts for the train step state.
+
+Hypothesis: the ~1,300 tiny boundary copies (PERF.md) are layout
+conversions between the default entry layouts of the ~430 state tensors
+and the layouts XLA's layout assignment wants internally. Compiling with
+``Format(Layout.AUTO)`` on inputs/outputs lets the compiler pick entry
+layouts; keeping the state in those layouts across steps removes the
+copies.
+"""
+
+import collections
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def entry_ops(text):
+    lines = text.splitlines()
+    start = next(i for i, l in enumerate(lines) if l.startswith("ENTRY"))
+    ops = collections.Counter()
+    for line in lines[start:]:
+        m = re.match(r"\s*(?:ROOT )?%?[\w.-]+ = \S+?\[[\d,]*\][^ ]* ([\w-]+)", line)
+        if m:
+            ops[m.group(1)] += 1
+    return ops
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.layout import Format, Layout
+
+    from dptpu.models import create_model
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    per_chip_batch = 128
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    step = make_train_step(
+        None, jnp.bfloat16, lr_schedule=make_step_decay_schedule(0.1, 100)
+    )
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": rng.randint(0, 256, (per_chip_batch, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (per_chip_batch,)).astype(np.int32),
+    }
+    batch = jax.device_put(batch)
+
+    # re-jit the underlying function with AUTO layouts
+    inner = step.__wrapped__
+    auto = Format(Layout.AUTO)
+    step_auto = jax.jit(
+        inner, donate_argnums=0, in_shardings=auto, out_shardings=auto
+    )
+    import jax.tree_util as jtu
+    absify = lambda t: jtu.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+    lowered = step_auto.lower(absify(state), absify(batch))
+    compiled = lowered.compile()
+    print("compiled ok")
+    ops = entry_ops(compiled.as_text())
+    print("auto-layout entry ops:", dict(ops.most_common(12)))
+
+    # figure out the input formats and put the state into them
+    in_fmts = compiled.input_formats
+    print("have input_formats:", in_fmts is not None)
+    st_fmt, batch_fmt = in_fmts[0]
+    state_l = jax.device_put(state, st_fmt)
+    batch_l = jax.device_put(batch, batch_fmt)
+
+    st, m = compiled(state_l, batch_l)
+    print("first step loss:", float(m["loss"]))
+
+    def window(iters):
+        nonlocal st
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            st, m = compiled(st, batch_l)
+        float(m["loss"])
+        return time.perf_counter() - t0
+
+    for _ in range(3):
+        st, m = compiled(st, batch_l)
+    float(m["loss"])
+    t_s = window(20)
+    t_l = window(120)
+    dt = (t_l - t_s) / 100.0
+    print(f"auto-layout: {dt*1e3:.2f} ms/step  ({per_chip_batch/dt:.1f} img/s)")
+
+
+if __name__ == "__main__":
+    main()

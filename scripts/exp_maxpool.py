@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Experiment: custom-VJP maxpool (3x3/2, pad 1) vs XLA select_and_scatter.
+
+The backward is reformulated as an elementwise "first-max mask" over the 9
+window offsets: input position (r,s) of window w receives g[w] iff
+x@(r,s) == y[w] and no earlier (row-major) offset equals y[w] — exactly
+select_and_scatter's GE-select semantics (first max wins ties). Unlike
+select_and_scatter, this is a plain fusion XLA can merge with the
+surrounding ReLU/BN backward, so the 205MB stem gradient needn't be
+materialized.
+
+Checks bitwise parity of fwd/bwd vs nn.max_pool on random + tie-heavy
+inputs, then times the full ResNet-50 train step with the custom pool.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_custom_maxpool():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.custom_vjp
+    def maxpool_3x3s2p1(x):
+        return _fwd_pool(x)
+
+    def _fwd_pool(x):
+        neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(
+            x, neg_inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)),
+        )
+
+    def fwd(x):
+        y = _fwd_pool(x)
+        return y, (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        b, h, w, c = x.shape
+        oh, ow = y.shape[1], y.shape[2]
+        # pad so every window offset is a uniform strided slice; -inf pad
+        # can never equal a real max so padded positions get no gradient
+        neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+        xp = lax.pad(x, neg_inf, ((0, 0, 0), (1, 2, 0), (1, 2, 0), (0, 0, 0)))
+        taken = jnp.zeros(y.shape, jnp.bool_)
+        dxp = jnp.zeros((b, h + 3, w + 3, c), g.dtype)
+        for r in range(3):
+            for s in range(3):
+                xrs = lax.slice(
+                    xp, (0, r, s, 0), (b, r + 2 * oh - 1, s + 2 * ow - 1, c),
+                    (1, 2, 2, 1),
+                )
+                eq = (xrs == y) & ~taken
+                taken = taken | (xrs == y)
+                contrib = jnp.where(eq, g, jnp.zeros((), g.dtype))
+                # place at input rows r-1+2i: interior-dilate by 1, offset r
+                placed = lax.pad(
+                    contrib, jnp.zeros((), g.dtype),
+                    ((0, 0, 0),
+                     (r, h + 3 - r - (2 * oh - 1), 1),
+                     (s, w + 3 - s - (2 * ow - 1), 1),
+                     (0, 0, 0)),
+                )
+                dxp = dxp + placed
+        dx = lax.slice(dxp, (0, 1, 1, 0), (b, h + 1, w + 1, c))
+        return (dx,)
+
+    maxpool_3x3s2p1.defvjp(fwd, bwd)
+    return maxpool_3x3s2p1
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    pool = make_custom_maxpool()
+
+    # ---- parity vs nn.max_pool (select_and_scatter bwd) ----
+    ref_pool = lambda x: nn.max_pool(
+        x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+    )
+    rng = np.random.RandomState(0)
+    for dtype, tie in [(jnp.float32, False), (jnp.bfloat16, False),
+                       (jnp.bfloat16, True), (jnp.float32, True)]:
+        x = rng.randn(2, 16, 16, 8).astype(np.float32)
+        if tie:  # heavy ties: quantize to few levels, many zeros like ReLU
+            x = np.maximum(np.round(x * 2) / 2, 0.0)
+        x = jnp.asarray(x, dtype)
+        g = jnp.asarray(rng.randn(2, 8, 8, 8), dtype)
+        y1, vjp1 = jax.vjp(ref_pool, x)
+        y2, vjp2 = jax.vjp(pool, x)
+        dx1, dx2 = vjp1(g)[0], vjp2(g)[0]
+        fwd_eq = bool(jnp.all(y1 == y2))
+        bwd_eq = bool(jnp.all(dx1 == dx2))
+        print(f"dtype={dtype.__name__} ties={tie}: fwd_eq={fwd_eq} bwd_eq={bwd_eq}",
+              "" if bwd_eq else f" max|d|={float(jnp.max(jnp.abs(dx1.astype(jnp.float32)-dx2.astype(jnp.float32)))):.4f}")
+
+    # ---- full step timing with the custom pool ----
+    import dptpu.models.layers as layers
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+    from dptpu.models import create_model
+
+    orig = layers.max_pool_same_as_torch
+
+    def patched(x, window, stride, padding):
+        if (window, stride, padding) == (3, 2, 1):
+            return pool(x)
+        return orig(x, window, stride, padding)
+
+    layers.max_pool_same_as_torch = patched
+    import dptpu.models.resnet as resnet_mod
+    resnet_mod.max_pool_same_as_torch = patched
+
+    per_chip_batch = 128
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    step = make_train_step(None, jnp.bfloat16,
+                           lr_schedule=make_step_decay_schedule(0.1, 100))
+    batch = jax.device_put({
+        "images": rng.randint(0, 256, (per_chip_batch, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (per_chip_batch,)).astype(np.int32),
+    })
+    st = state
+    for _ in range(3):
+        st, m = step(st, batch)
+    float(m["loss"])
+
+    def window(iters):
+        nonlocal_st = [st]
+        t0 = time.perf_counter()
+        s = nonlocal_st[0]
+        for _ in range(iters):
+            s, m = step(s, batch)
+        float(m["loss"])
+        return time.perf_counter() - t0, s
+
+    t_s, st = window(20)
+    t_l, st = window(120)
+    dt = (t_l - t_s) / 100.0
+    print(f"custom-maxpool step: {dt*1e3:.2f} ms/step  ({per_chip_batch/dt:.1f} img/s)")
+
+    text = step.lower(state, batch).compile().as_text()
+    print("select-and-scatter in HLO:", text.count("select-and-scatter("))
+
+
+if __name__ == "__main__":
+    main()

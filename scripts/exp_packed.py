@@ -1,0 +1,201 @@
+#!/usr/bin/env python3
+"""Experiment: channel-packed 1-D state vs the stock train step.
+
+Hypothesis (PERF.md round-2 headroom #1): the ~1,300 tiny async copies at
+the step boundary come from carrying ~430 separate state tensors in/out of
+the compiled program; packing every 1-D f32 leaf (BN scale/bias, BN
+running stats, fc bias, and their momentum buffers) into single flat
+vectors removes them. The packed step differentiates directly w.r.t. the
+flat parameter vector so the gradient + momentum + SGD chain over all of
+them is a single fused elementwise op.
+
+Prints step-time for stock vs packed (two-point differencing) and checks
+numerical parity of the losses over the first steps.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_packer(template_leaves):
+    """Pack 1-D leaves of a flattened pytree into one flat f32 vector.
+
+    Returns (pack, unpack, n_packed): ``pack(leaves) -> (flat, big_list)``
+    on host or device; ``unpack(flat, big_list) -> leaves``.
+    """
+    import jax.numpy as jnp
+
+    mask = [l.ndim == 1 and l.dtype == jnp.float32 for l in template_leaves]
+    sizes = [int(l.size) for l in template_leaves]
+    offsets = []
+    off = 0
+    for m, s in zip(mask, sizes):
+        offsets.append(off)
+        if m:
+            off += s
+    total = off
+
+    def pack(leaves):
+        flat = jnp.concatenate([l for l, m in zip(leaves, mask) if m]) if total else jnp.zeros((0,), jnp.float32)
+        big = [l for l, m in zip(leaves, mask) if not m]
+        return flat, big
+
+    def unpack(flat, big):
+        out = []
+        bi = 0
+        for i, m in enumerate(mask):
+            if m:
+                out.append(jax.lax.dynamic_slice(flat, (offsets[i],), (sizes[i],)))
+            else:
+                out.append(big[bi])
+                bi += 1
+        return out
+
+    import jax
+
+    return pack, unpack, total
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import tree_util as jtu
+
+    from dptpu.models import create_model
+    from dptpu.ops.loss import cross_entropy_loss
+    from dptpu.ops.metrics import topk_correct_fraction
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    per_chip_batch = 128
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    lr_schedule = make_step_decay_schedule(0.1, 100)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": rng.randint(0, 256, (per_chip_batch, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (per_chip_batch,)).astype(np.int32),
+    }
+    batch = jax.device_put(batch)
+
+    # ---- stock step ----
+    stock_step = make_train_step(None, jnp.bfloat16, lr_schedule=lr_schedule)
+
+    # ---- packed step ----
+    p_leaves, p_def = jtu.tree_flatten(state.params)
+    s_leaves, s_def = jtu.tree_flatten(state.batch_stats)
+    pack_p, unpack_p, n_p = build_packer(p_leaves)
+    pack_s, unpack_s, n_s = build_packer(s_leaves)
+    print(f"packed param floats: {n_p}, packed stat floats: {n_s}")
+    momentum, weight_decay = 0.9, 1e-4
+
+    def pack_state(state):
+        flat_p, big_p = pack_p(jtu.tree_leaves(state.params))
+        flat_s, big_s = pack_s(jtu.tree_leaves(state.batch_stats))
+        assert not big_s
+        # trace state mirrors params structure
+        buf = state.opt_state[1].trace
+        flat_b, big_b = pack_p(jtu.tree_leaves(buf))
+        return dict(step=state.step, flat_p=flat_p, big_p=big_p,
+                    flat_s=flat_s, flat_b=flat_b, big_b=big_b)
+
+    def packed_step(carry, batch):
+        images = batch["images"]
+        mean = jnp.asarray([0.485, 0.456, 0.406], jnp.float32) * 255.0
+        std = jnp.asarray([0.229, 0.224, 0.225], jnp.float32) * 255.0
+        images = ((images.astype(jnp.float32) - mean) / std).astype(jnp.bfloat16)
+        labels = batch["labels"]
+
+        def loss_fn(flat_p, big_p):
+            params = p_def.unflatten(unpack_p(flat_p, big_p))
+            stats = s_def.unflatten(unpack_s(carry["flat_s"], []))
+            out, mutated = model.apply(
+                {"params": params, "batch_stats": stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            loss = cross_entropy_loss(out, labels)
+            return loss, (out, mutated["batch_stats"])
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(carry["flat_p"], carry["big_p"])
+        g_flat, g_big = grads
+        top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
+        lr = lr_schedule(carry["step"])
+        # torch SGD: g += wd*p ; buf = mu*buf + g ; p -= lr*buf
+        g_flat = g_flat + weight_decay * carry["flat_p"]
+        new_fb = momentum * carry["flat_b"] + g_flat
+        new_fp = carry["flat_p"] - lr * new_fb
+        new_bb = [momentum * b + (g + weight_decay * p)
+                  for b, g, p in zip(carry["big_b"], g_big, carry["big_p"])]
+        new_bp = [p - lr * b for p, b in zip(carry["big_p"], new_bb)]
+        new_fs, _ = pack_s(jtu.tree_leaves(new_stats))
+        new_carry = dict(step=carry["step"] + 1, flat_p=new_fp, big_p=new_bp,
+                         flat_s=new_fs, flat_b=new_fb, big_b=new_bb)
+        metrics = {"loss": loss, "top1": top1 * 100.0, "top5": top5 * 100.0,
+                   "lr": jnp.asarray(lr, jnp.float32)}
+        return new_carry, metrics
+
+    packed_jit = jax.jit(packed_step, donate_argnums=0)
+
+    # ---- parity check ----
+    fresh = lambda t: jtu.tree_map(jnp.copy, t)
+    st = fresh(state)
+    carry = pack_state(fresh(state))
+    stock_losses, packed_losses = [], []
+    for _ in range(4):
+        st, m1 = stock_step(st, batch)
+        carry, m2 = packed_jit(carry, batch)
+        stock_losses.append(float(m1["loss"]))
+        packed_losses.append(float(m2["loss"]))
+    print("stock  losses:", stock_losses)
+    print("packed losses:", packed_losses)
+
+    # ---- timing (two-point differencing, same as bench.py) ----
+    def time_step(fn, st0):
+        st = st0
+        for _ in range(3):
+            st, m = fn(st, batch)
+        float(m["loss"])
+
+        def window(iters):
+            nonlocal st
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st, m = fn(st, batch)
+            float(m["loss"])
+            return time.perf_counter() - t0
+
+        t_s = window(20)
+        t_l = window(120)
+        return (t_l - t_s) / 100.0
+
+    t_stock = time_step(stock_step, fresh(state))
+    t_packed = time_step(packed_jit, pack_state(fresh(state)))
+    print(f"stock:  {t_stock*1e3:.2f} ms/step  ({per_chip_batch/t_stock:.1f} img/s)")
+    print(f"packed: {t_packed*1e3:.2f} ms/step  ({per_chip_batch/t_packed:.1f} img/s)")
+
+    # copy census of the packed program
+    import collections, re
+    text = packed_jit.lower(pack_state(fresh(state)), batch).compile().as_text()
+    lines = text.splitlines()
+    start = next(i for i, l in enumerate(lines) if l.startswith("ENTRY"))
+    ops = collections.Counter()
+    for line in lines[start:]:
+        m = re.match(r"\s*(?:ROOT )?%?[\w.-]+ = \S+?\[[\d,]*\][^ ]* ([\w-]+)", line)
+        if m:
+            ops[m.group(1)] += 1
+    print("packed entry ops:", dict(ops.most_common(12)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Experiment 2: FULL state packing (every f32 leaf -> one flat vector).
+
+Params, momentum buffers and BN stats each live in a single flat f32
+buffer; conv kernels are bitcast-reshaped views sliced out inside the
+step. Gradient is taken w.r.t. the flat buffer so the whole SGD chain is
+one fused elementwise op and the step boundary carries 3 big tensors
+instead of ~430 small ones.
+
+Interleaved A/B timing vs the stock step (contention drifts +-4% over
+minutes, PERF.md), reporting per-variant medians of per-rep rates.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import tree_util as jtu
+
+    from dptpu.models import create_model
+    from dptpu.ops.loss import cross_entropy_loss
+    from dptpu.ops.metrics import topk_correct_fraction
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    per_chip_batch = 128
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    lr_schedule = make_step_decay_schedule(0.1, 100)
+
+    rng = np.random.RandomState(0)
+    batch = jax.device_put({
+        "images": rng.randint(0, 256, (per_chip_batch, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (per_chip_batch,)).astype(np.int32),
+    })
+
+    stock_step = make_train_step(None, jnp.bfloat16, lr_schedule=lr_schedule)
+
+    # ---- full packer over a template pytree ----
+    def make_full_packer(template):
+        leaves, treedef = jtu.tree_flatten(template)
+        shapes = [l.shape for l in leaves]
+        sizes = [int(l.size) for l in leaves]
+        offs = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+        total = int(offs[-1])
+
+        def pack(tree):
+            ls = jtu.tree_leaves(tree)
+            return jnp.concatenate([l.reshape(-1) for l in ls])
+
+        def unpack(flat):
+            out = [
+                jax.lax.dynamic_slice(flat, (int(offs[i]),), (sizes[i],)).reshape(shapes[i])
+                for i in range(len(sizes))
+            ]
+            return treedef.unflatten(out)
+
+        return pack, unpack, total
+
+    pack_p, unpack_p, n_p = make_full_packer(state.params)
+    pack_s, unpack_s, n_s = make_full_packer(state.batch_stats)
+    print(f"param floats: {n_p} ({n_p*4/1e6:.1f} MB), stat floats: {n_s}")
+    momentum, weight_decay = 0.9, 1e-4
+
+    def pack_state(state):
+        return dict(
+            step=state.step,
+            flat_p=pack_p(state.params),
+            flat_s=pack_s(state.batch_stats),
+            flat_b=pack_p(state.opt_state[1].trace),
+        )
+
+    def packed_step(carry, batch):
+        images = batch["images"]
+        mean = jnp.asarray([0.485, 0.456, 0.406], jnp.float32) * 255.0
+        std = jnp.asarray([0.229, 0.224, 0.225], jnp.float32) * 255.0
+        images = ((images.astype(jnp.float32) - mean) / std).astype(jnp.bfloat16)
+        labels = batch["labels"]
+
+        def loss_fn(flat_p):
+            params = unpack_p(flat_p)
+            stats = unpack_s(carry["flat_s"])
+            out, mutated = model.apply(
+                {"params": params, "batch_stats": stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            loss = cross_entropy_loss(out, labels)
+            return loss, (out, mutated["batch_stats"])
+
+        (loss, (logits, new_stats)), g = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(carry["flat_p"])
+        top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
+        lr = lr_schedule(carry["step"])
+        g = g + weight_decay * carry["flat_p"]
+        new_b = momentum * carry["flat_b"] + g
+        new_p = carry["flat_p"] - lr * new_b
+        new_carry = dict(step=carry["step"] + 1, flat_p=new_p,
+                         flat_s=pack_s(new_stats), flat_b=new_b)
+        metrics = {"loss": loss, "top1": top1 * 100.0, "top5": top5 * 100.0,
+                   "lr": jnp.asarray(lr, jnp.float32)}
+        return new_carry, metrics
+
+    packed_jit = jax.jit(packed_step, donate_argnums=0)
+
+    fresh = lambda t: jtu.tree_map(jnp.copy, t)
+
+    # parity
+    st = fresh(state)
+    carry = pack_state(fresh(state))
+    sl, pl = [], []
+    for _ in range(3):
+        st, m1 = stock_step(st, batch)
+        carry, m2 = packed_jit(carry, batch)
+        sl.append(float(m1["loss"]))
+        pl.append(float(m2["loss"]))
+    print("stock  losses:", sl)
+    print("packed losses:", pl)
+
+    # entry-op census
+    import collections, re
+    text = packed_jit.lower(pack_state(fresh(state)), batch).compile().as_text()
+    lines = text.splitlines()
+    start = next(i for i, l in enumerate(lines) if l.startswith("ENTRY"))
+    ops = collections.Counter()
+    for line in lines[start:]:
+        m = re.match(r"\s*(?:ROOT )?%?[\w.-]+ = \S+?\[[\d,]*\][^ ]* ([\w-]+)", line)
+        if m:
+            ops[m.group(1)] += 1
+    print("packed entry ops:", dict(ops.most_common(8)))
+
+    # ---- interleaved A/B timing ----
+    def timer(fn, st0):
+        holder = {"st": st0}
+
+        def window(iters):
+            st = holder["st"]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st, m = fn(st, batch)
+            float(m["loss"])
+            holder["st"] = st
+            return time.perf_counter() - t0
+
+        return window
+
+    wa = timer(stock_step, fresh(state))
+    wb = timer(packed_jit, pack_state(fresh(state)))
+    wa(5); wb(5)  # warm both
+    ras, rbs = [], []
+    for rep in range(3):
+        for name, w, acc in (("stock", wa, ras), ("packed", wb, rbs)):
+            ts = w(20)
+            tl = w(120)
+            acc.append((tl - ts) / 100.0)
+    print("stock  ms/step:", [f"{t*1e3:.2f}" for t in ras],
+          f"median {np.median(ras)*1e3:.2f}")
+    print("packed ms/step:", [f"{t*1e3:.2f}" for t in rbs],
+          f"median {np.median(rbs)*1e3:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+#!/usr/bin/env python3
+"""Experiment: fused affine BN+ReLU+maxpool stem with a custom VJP.
+
+Region: y = maxpool_3x3s2p1(relu(gamma_t*z + beta_t)) as ONE custom-vjp
+boundary (z = stem conv output, gamma_t/beta_t the BN affine folded with
+the batch statistics). Forward is a single fusion z->y: the 112x112 ReLU
+output is never materialized. Backward:
+  fusion1 (z -> widx,zwin): 9-way first-strict-max of the affine values
+          per window (select_and_scatter's GE tie-break), also records the
+          winning z value so d(gamma_t) never re-reads the 112x112 plane.
+  fusion2 (g,widx -> dz): parity-interleaved gather (each input position
+          belongs to <=4 windows; even/odd rows and cols pick static
+          window offsets), multiplied by gamma_t.
+  d(gamma_t) = sum(g_relu * zwin), d(beta_t) = sum(g_relu) on the 56x56
+          grid.
+Checks value + grad parity vs the stock flax BN -> relu -> nn.max_pool
+stem, then interleaved A/B full-step timing.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_fused(jax, jnp, lax):
+    @jax.custom_vjp
+    def affine_relu_pool(z, gamma_t, beta_t):
+        a = gamma_t * z + beta_t
+        neg_inf = jnp.asarray(-jnp.inf, a.dtype)
+        pooled = lax.reduce_window(
+            a, neg_inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            ((0, 0), (1, 1), (1, 1), (0, 0)),
+        )
+        return jnp.maximum(pooled, jnp.zeros((), a.dtype))
+
+    def fwd(z, gamma_t, beta_t):
+        y = affine_relu_pool(z, gamma_t, beta_t)
+        return y, (z, gamma_t, beta_t, y)
+
+    def bwd(res, g):
+        z, gamma_t, beta_t, y = res
+        b, h, w, c = z.shape
+        oh, ow = y.shape[1], y.shape[2]
+        dt = z.dtype
+        # mask g by relu': a window whose max is <= 0 emits y == 0 and gets
+        # no gradient (torch/XLA relu grad at exactly 0 is 0)
+        gm = jnp.where(y > 0, g, jnp.zeros((), g.dtype))
+
+        # ---- fusion 1: winner offset index + winning z per window ----
+        a = gamma_t * z + beta_t
+        neg_inf = jnp.asarray(-jnp.inf, dt)
+        ap = lax.pad(a, neg_inf, ((0, 0, 0), (1, 1, 0), (1, 1, 0), (0, 0, 0)))
+        zp = lax.pad(z, jnp.zeros((), dt), ((0, 0, 0), (1, 1, 0), (1, 1, 0), (0, 0, 0)))
+        best = None
+        for r in range(3):
+            for s in range(3):
+                k = 3 * r + s
+                ars = lax.slice(ap, (0, r, s, 0), (b, r + 2 * oh - 1, s + 2 * ow - 1, c), (1, 2, 2, 1))
+                zrs = lax.slice(zp, (0, r, s, 0), (b, r + 2 * oh - 1, s + 2 * ow - 1, c), (1, 2, 2, 1))
+                if best is None:
+                    best, widx, zwin = ars, jnp.zeros(ars.shape, jnp.uint8), zrs
+                else:
+                    gt = ars > best  # strict: earlier offset keeps ties
+                    best = jnp.maximum(ars, best)
+                    widx = jnp.where(gt, jnp.uint8(k), widx)
+                    zwin = jnp.where(gt, zrs, zwin)
+
+        # ---- per-channel affine grads on the small grid ----
+        gm32 = gm.astype(jnp.float32)
+        dgamma_t = (gm32 * zwin.astype(jnp.float32)).sum(axis=(0, 1, 2))
+        dbeta_t = gm32.sum(axis=(0, 1, 2))
+
+        # ---- fusion 2: parity-interleaved routing to the input grid ----
+        zpad = jnp.zeros((), g.dtype)
+        gp = lax.pad(gm, zpad, ((0, 0, 0), (0, 1, 0), (0, 1, 0), (0, 0, 0)))
+        wp = lax.pad(widx, jnp.uint8(255), ((0, 0, 0), (0, 1, 0), (0, 1, 0), (0, 0, 0)))
+
+        def T(di, dj, r, s):
+            gs = lax.slice(gp, (0, di, dj, 0), (b, di + oh, dj + ow, c))
+            ws = lax.slice(wp, (0, di, dj, 0), (b, di + oh, dj + ow, c))
+            return jnp.where(ws == np.uint8(3 * r + s), gs, zpad)
+
+        dx00 = T(0, 0, 1, 1)
+        dx01 = T(0, 0, 1, 2) + T(0, 1, 1, 0)
+        dx10 = T(0, 0, 2, 1) + T(1, 0, 0, 1)
+        dx11 = T(0, 0, 2, 2) + T(0, 1, 2, 0) + T(1, 0, 0, 2) + T(1, 1, 0, 0)
+        # stack over column parity on a new axis after w, row parity after h
+        inner0 = jnp.stack([dx00, dx01], axis=3)  # [B,oh,ow,2,C]
+        inner1 = jnp.stack([dx10, dx11], axis=3)
+        dy = jnp.stack([inner0, inner1], axis=2)  # [B,oh,2,ow,2,C]
+        dy = dy.reshape(b, 2 * oh, 2 * ow, c)
+        dz = (gamma_t.astype(dy.dtype) * dy).astype(dt)
+        return dz, dgamma_t.astype(gamma_t.dtype), dbeta_t.astype(beta_t.dtype)
+
+    affine_relu_pool.defvjp(fwd, bwd)
+    return affine_relu_pool
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from flax import linen as nn
+
+    fused = make_fused(jax, jnp, lax)
+
+    # ---- parity vs stock bn-apply -> relu -> nn.max_pool ----
+    def stock(z, gamma_t, beta_t):
+        x = nn.relu(gamma_t * z + beta_t)
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+    rng = np.random.RandomState(0)
+    for dtype, tie in [(jnp.float32, False), (jnp.float32, True), (jnp.bfloat16, True)]:
+        z = rng.randn(2, 16, 16, 8).astype(np.float32)
+        if tie:
+            z = np.round(z * 2) / 2
+        z = jnp.asarray(z, dtype)
+        gamma_t = jnp.asarray(rng.randn(8) * 0.5 + 1.0, dtype)
+        gamma_t = gamma_t.at[0].set(-0.7)  # negative scale: order flips
+        beta_t = jnp.asarray(rng.randn(8) * 0.1, dtype)
+        g = jnp.asarray(rng.randn(2, 8, 8, 8), dtype)
+        y1, vjp1 = jax.vjp(stock, z, gamma_t, beta_t)
+        y2, vjp2 = jax.vjp(fused, z, gamma_t, beta_t)
+        d1, d2 = vjp1(g), vjp2(g)
+        print(f"dtype={dtype.__name__} ties={tie}: fwd_max|d|="
+              f"{float(jnp.max(jnp.abs(y1.astype(jnp.float32)-y2.astype(jnp.float32)))):.6f}", end=" ")
+        for name, a_, b_ in [("dz", d1[0], d2[0]), ("dgam", d1[1], d2[1]), ("dbeta", d1[2], d2[2])]:
+            diff = float(jnp.max(jnp.abs(a_.astype(jnp.float32) - b_.astype(jnp.float32))))
+            denom = float(jnp.max(jnp.abs(a_.astype(jnp.float32)))) + 1e-9
+            print(f"{name}_rel={diff/denom:.2e}", end=" ")
+        print()
+
+    # ---- full-step A/B ----
+    import dptpu.models.resnet as resnet_mod
+    from dptpu.models import create_model
+    from dptpu.ops.loss import cross_entropy_loss
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+    from flax.linen import compact
+
+    per_chip_batch = 128
+    model = create_model("resnet50", dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 224, 224, 3)
+    )
+    step_stock = make_train_step(None, jnp.bfloat16,
+                                 lr_schedule=make_step_decay_schedule(0.1, 100))
+
+    # fused-stem model: ResNet subclass replacing bn1->relu->maxpool with
+    # manual flax-BN stats + the fused region
+    def fused_call(self, x, train=False):
+        from functools import partial
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       kernel_init=resnet_mod.kaiming_normal_fan_out)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5,
+                       dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+                       param_dtype=jnp.float32, axis_name=self.bn_axis_name)
+        x = resnet_mod._Stem(dtype=self.dtype, param_dtype=self.param_dtype,
+                             space_to_depth=self.stem_space_to_depth,
+                             name="conv1")(x)
+        x = FusedBNReLUPool(train=train, name="bn1")(x)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = self.block_cls(planes=64 * 2 ** i,
+                                   stride=2 if i > 0 and j == 0 else 1,
+                                   conv=conv, norm=norm,
+                                   name=f"layer{i + 1}_block{j}")(x)
+        x = x.mean(axis=(1, 2))
+        fan_in = x.shape[-1]
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     kernel_init=resnet_mod.torch_default_kernel_init,
+                     bias_init=resnet_mod.torch_default_bias_init(fan_in),
+                     name="fc")(x)
+        return x
+
+    class FusedBNReLUPool(nn.Module):
+        train: bool = False
+
+        @compact
+        def __call__(self, z):
+            c = z.shape[-1]
+            scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+            ra_mean = self.variable("batch_stats", "mean",
+                                    lambda: jnp.zeros((c,), jnp.float32))
+            ra_var = self.variable("batch_stats", "var",
+                                   lambda: jnp.ones((c,), jnp.float32))
+            if self.train:
+                zf = z.astype(jnp.float32)
+                mean = zf.mean(axis=(0, 1, 2))
+                mean2 = (zf * zf).mean(axis=(0, 1, 2))
+                var = mean2 - mean * mean  # flax biased batch var
+                if not self.is_initializing():
+                    ra_mean.value = 0.9 * ra_mean.value + 0.1 * mean
+                    ra_var.value = 0.9 * ra_var.value + 0.1 * var
+            else:
+                mean, var = ra_mean.value, ra_var.value
+            gamma_t = scale * jax.lax.rsqrt(var + 1e-5)
+            beta_t = bias - mean * gamma_t
+            return fused(z, gamma_t.astype(z.dtype), beta_t.astype(z.dtype))
+
+    FusedStemResNet = type(
+        "FusedStemResNet", (resnet_mod.ResNet,), {"__call__": compact(fused_call)}
+    )
+    model2 = FusedStemResNet(stage_sizes=[3, 4, 6, 3],
+                             block_cls=resnet_mod.Bottleneck,
+                             dtype=jnp.bfloat16)
+    state2 = create_train_state(
+        jax.random.PRNGKey(0), model2, tx, input_shape=(1, 224, 224, 3)
+    )
+    step_fused = make_train_step(None, jnp.bfloat16,
+                                 lr_schedule=make_step_decay_schedule(0.1, 100))
+
+    batch = jax.device_put({
+        "images": rng.randint(0, 256, (per_chip_batch, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (per_chip_batch,)).astype(np.int32),
+    })
+
+    import jax.tree_util as jtu
+    fresh = lambda t: jtu.tree_map(jnp.copy, t)
+
+    s1, s2 = fresh(state), fresh(state2)
+    l1, l2 = [], []
+    for _ in range(3):
+        s1, m1 = step_stock(s1, batch)
+        s2, m2 = step_fused(s2, batch)
+        l1.append(float(m1["loss"]))
+        l2.append(float(m2["loss"]))
+    print("stock losses:", l1)
+    print("fused losses:", l2)
+
+    def timer(fn, st0):
+        holder = {"st": st0}
+
+        def window(iters):
+            st = holder["st"]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st, m = fn(st, batch)
+            float(m["loss"])
+            holder["st"] = st
+            return time.perf_counter() - t0
+
+        return window
+
+    wa = timer(step_stock, fresh(state))
+    wb = timer(step_fused, fresh(state2))
+    wa(5); wb(5)
+    ra, rb = [], []
+    for rep in range(3):
+        ts = wa(20); tl = wa(120); ra.append((tl - ts) / 100.0)
+        ts = wb(20); tl = wb(120); rb.append((tl - ts) / 100.0)
+    print("stock ms/step:", [f"{t*1e3:.2f}" for t in ra], f"median {np.median(ra)*1e3:.2f}")
+    print("fused ms/step:", [f"{t*1e3:.2f}" for t in rb], f"median {np.median(rb)*1e3:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Dump the fused-stem step HLO and look for the expensive stem-bwd ops."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from exp_stem import make_fused  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from flax import linen as nn
+    from flax.linen import compact
+
+    import dptpu.models.resnet as resnet_mod
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    fused = make_fused(jax, jnp, lax)
+
+    class FusedBNReLUPool(nn.Module):
+        train: bool = False
+
+        @compact
+        def __call__(self, z):
+            c = z.shape[-1]
+            scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+            ra_mean = self.variable("batch_stats", "mean",
+                                    lambda: jnp.zeros((c,), jnp.float32))
+            ra_var = self.variable("batch_stats", "var",
+                                   lambda: jnp.ones((c,), jnp.float32))
+            if self.train:
+                zf = z.astype(jnp.float32)
+                mean = zf.mean(axis=(0, 1, 2))
+                mean2 = (zf * zf).mean(axis=(0, 1, 2))
+                var = mean2 - mean * mean
+                if not self.is_initializing():
+                    ra_mean.value = 0.9 * ra_mean.value + 0.1 * mean
+                    ra_var.value = 0.9 * ra_var.value + 0.1 * var
+            else:
+                mean, var = ra_mean.value, ra_var.value
+            gamma_t = scale * jax.lax.rsqrt(var + 1e-5)
+            beta_t = bias - mean * gamma_t
+            return fused(z, gamma_t.astype(z.dtype), beta_t.astype(z.dtype))
+
+    def fused_call(self, x, train=False):
+        from functools import partial
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       kernel_init=resnet_mod.kaiming_normal_fan_out)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32, axis_name=self.bn_axis_name)
+        x = resnet_mod._Stem(dtype=self.dtype, param_dtype=self.param_dtype,
+                             space_to_depth=False, name="conv1")(x)
+        x = FusedBNReLUPool(train=train, name="bn1")(x)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = self.block_cls(planes=64 * 2 ** i,
+                                   stride=2 if i > 0 and j == 0 else 1,
+                                   conv=conv, norm=norm,
+                                   name=f"layer{i + 1}_block{j}")(x)
+        x = x.mean(axis=(1, 2))
+        fan_in = x.shape[-1]
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     kernel_init=resnet_mod.torch_default_kernel_init,
+                     bias_init=resnet_mod.torch_default_bias_init(fan_in),
+                     name="fc")(x)
+        return x
+
+    FusedStemResNet = type("FusedStemResNet", (resnet_mod.ResNet,),
+                           {"__call__": compact(fused_call)})
+    model = FusedStemResNet(stage_sizes=[3, 4, 6, 3],
+                            block_cls=resnet_mod.Bottleneck, dtype=jnp.bfloat16)
+    tx = make_optimizer(0.9, 1e-4)
+    state = create_train_state(jax.random.PRNGKey(0), model, tx,
+                               input_shape=(1, 224, 224, 3))
+    step = make_train_step(None, jnp.bfloat16,
+                           lr_schedule=make_step_decay_schedule(0.1, 100))
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": rng.randint(0, 256, (128, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (128,)).astype(np.int32),
+    }
+    text = step.lower(state, batch).compile().as_text()
+    with open("/tmp/fused_hlo.txt", "w") as f:
+        f.write(text)
+    import re
+    lines = text.splitlines()
+    start = next(i for i, l in enumerate(lines) if l.startswith("ENTRY"))
+    # find big (>= 100x100 spatial) non-conv ops in entry
+    for line in lines[start:]:
+        m = re.match(r"\s*(?:ROOT )?%?([\w.-]+) = (\S+?\[[\d,]*\]\S*) ([\w-]+)", line)
+        if not m:
+            continue
+        name, shp, op = m.groups()
+        if op in ("transpose", "reshape", "concatenate", "select-and-scatter", "reduce-window", "pad", "slice"):
+            if re.search(r"\[\d*,?1?1[0-9],", shp) or "112" in shp or "113" in shp:
+                print(f"{op:18s} {shp[:70]} {name[:40]}")
+    print("---- totals ----")
+    for op in ("transpose", "concatenate", "reduce-window", "select-and-scatter"):
+        print(op, text.count(f" {op}("))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Profile stock vs fused-stem steps; print per-op-bucket diffs."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+from prof_util import print_profile, profile_step
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from exp_stem_hlo import main as _unused  # noqa: F401  (reuse builders below)
+    import exp_stem_hlo  # noqa: F401
+
+    # rebuild the two models inline (same code path as exp_stem_hlo)
+    from exp_stem import make_fused
+    from jax import lax
+    from flax import linen as nn
+    from flax.linen import compact
+    import dptpu.models.resnet as resnet_mod
+    from dptpu.models import create_model
+    from dptpu.ops.schedules import make_step_decay_schedule
+    from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+    fused = make_fused(jax, jnp, lax)
+
+    class FusedBNReLUPool(nn.Module):
+        train: bool = False
+
+        @compact
+        def __call__(self, z):
+            c = z.shape[-1]
+            scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+            ra_mean = self.variable("batch_stats", "mean",
+                                    lambda: jnp.zeros((c,), jnp.float32))
+            ra_var = self.variable("batch_stats", "var",
+                                   lambda: jnp.ones((c,), jnp.float32))
+            if self.train:
+                zf = z.astype(jnp.float32)
+                mean = zf.mean(axis=(0, 1, 2))
+                mean2 = (zf * zf).mean(axis=(0, 1, 2))
+                var = mean2 - mean * mean
+                if not self.is_initializing():
+                    ra_mean.value = 0.9 * ra_mean.value + 0.1 * mean
+                    ra_var.value = 0.9 * ra_var.value + 0.1 * var
+            else:
+                mean, var = ra_mean.value, ra_var.value
+            gamma_t = scale * jax.lax.rsqrt(var + 1e-5)
+            beta_t = bias - mean * gamma_t
+            return fused(z, gamma_t.astype(z.dtype), beta_t.astype(z.dtype))
+
+    def fused_call(self, x, train=False):
+        from functools import partial
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       kernel_init=resnet_mod.kaiming_normal_fan_out)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32, axis_name=self.bn_axis_name)
+        x = resnet_mod._Stem(dtype=self.dtype, param_dtype=self.param_dtype,
+                             space_to_depth=False, name="conv1")(x)
+        x = FusedBNReLUPool(train=train, name="bn1")(x)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = self.block_cls(planes=64 * 2 ** i,
+                                   stride=2 if i > 0 and j == 0 else 1,
+                                   conv=conv, norm=norm,
+                                   name=f"layer{i + 1}_block{j}")(x)
+        x = x.mean(axis=(1, 2))
+        fan_in = x.shape[-1]
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     kernel_init=resnet_mod.torch_default_kernel_init,
+                     bias_init=resnet_mod.torch_default_bias_init(fan_in),
+                     name="fc")(x)
+        return x
+
+    FusedStemResNet = type("FusedStemResNet", (resnet_mod.ResNet,),
+                           {"__call__": compact(fused_call)})
+
+    tx = make_optimizer(0.9, 1e-4)
+    rng = np.random.RandomState(0)
+    batch = jax.device_put({
+        "images": rng.randint(0, 256, (128, 224, 224, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 1000, (128,)).astype(np.int32),
+    })
+    sched = make_step_decay_schedule(0.1, 100)
+
+    model1 = create_model("resnet50", dtype=jnp.bfloat16)
+    st1 = create_train_state(jax.random.PRNGKey(0), model1, tx,
+                             input_shape=(1, 224, 224, 3))
+    step1 = make_train_step(None, jnp.bfloat16, lr_schedule=sched)
+    t1, p1, _ = profile_step(step1, st1, batch)
+    print_profile("stock", t1, p1)
+
+    model2 = FusedStemResNet(stage_sizes=[3, 4, 6, 3],
+                             block_cls=resnet_mod.Bottleneck, dtype=jnp.bfloat16)
+    st2 = create_train_state(jax.random.PRNGKey(0), model2, tx,
+                             input_shape=(1, 224, 224, 3))
+    step2 = make_train_step(None, jnp.bfloat16, lr_schedule=sched)
+    t2, p2, _ = profile_step(step2, st2, batch)
+    print_profile("fused", t2, p2)
+
+    keys = set(p1) | set(p2)
+    print("== diffs (fused - stock, ms) ==")
+    for k in sorted(keys, key=lambda k: -(p2.get(k, 0) - p1.get(k, 0))):
+        d = p2.get(k, 0) - p1.get(k, 0)
+        if abs(d) > 0.05:
+            print(f"  {k:34s} {d:+7.3f}  ({p1.get(k,0):.3f} -> {p2.get(k,0):.3f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Probe which Mosaic ops the fused-stem kernel needs are supported:
+(a) interior singleton index on a 4-D ref block
+(b) leading-dim parity reshape + unit-stride slice on 3-D vectors
+(c) stack+reshape interleave on leading dims
+(d) scalar SMEM param read
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax import lax
+
+    H, B = 16, 128  # H even; B lanes
+
+    def probe(name, kernel, out_shape, x):
+        try:
+            fn = pl.pallas_call(
+                kernel,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((H, H, 1, B), lambda i: (0, 0, i, 0),
+                                       memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec((H, H, 1, B), lambda i: (0, 0, i, 0),
+                                       memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
+            )
+            r = fn(x)
+            r.block_until_ready()
+            print(f"{name}: OK")
+            return r
+        except Exception as e:
+            print(f"{name}: FAIL — {str(e)[:180]}")
+            return None
+
+    x = jnp.asarray(np.random.randn(H, H, 2, B), jnp.float32)
+
+    # (a) interior singleton squeeze + write back
+    def k_squeeze(x_ref, o_ref):
+        v = x_ref[:, :, 0, :]          # [H,H,B]
+        o_ref[:, :, 0, :] = v * 2.0
+
+    probe("interior-squeeze", k_squeeze, (H, H, 2, B), x)
+
+    # (b) parity reshape + slice: rows 2q+p
+    def k_parity(x_ref, o_ref):
+        v = x_ref[:, :, 0, :]                      # [16,16,B]
+        v4 = v.reshape(H // 2, 2, H, B)            # [8,2,16,B]
+        even = lax.slice(v4, (0, 0, 0, 0), (H // 2, 1, H, B)).reshape(H // 2, H, B)
+        odd = lax.slice(v4, (0, 1, 0, 0), (H // 2, 2, H, B)).reshape(H // 2, H, B)
+        o_ref[: H // 2, :, 0, :] = even
+        o_ref[H // 2:, :, 0, :] = odd
+
+    probe("parity-reshape-rows", k_parity, (H, H, 2, B), x)
+
+    # (b2) same on the second (sublane-ish) dim
+    def k_parity_col(x_ref, o_ref):
+        v = x_ref[:, :, 0, :]
+        v4 = v.reshape(H, H // 2, 2, B)
+        even = lax.slice(v4, (0, 0, 0, 0), (H, H // 2, 1, B)).reshape(H, H // 2, B)
+        odd = lax.slice(v4, (0, 0, 1, 0), (H, H // 2, 2, B)).reshape(H, H // 2, B)
+        o_ref[:, : H // 2, 0, :] = even
+        o_ref[:, H // 2:, 0, :] = odd
+
+    probe("parity-reshape-cols", k_parity_col, (H, H, 2, B), x)
+
+    # (c) interleave: stack + reshape back
+    def k_interleave(x_ref, o_ref):
+        v = x_ref[:, :, 0, :]
+        a = v[: H // 2]
+        b = v[H // 2:]
+        st = jnp.stack([a, b], axis=1)             # [8,2,16,B]
+        o_ref[:, :, 0, :] = st.reshape(H, H, B)
+
+    probe("interleave-stack-reshape", k_interleave, (H, H, 2, B), x)
+
+    # (d) scratch + accumulate into small out over grid
+    def k_accum(x_ref, o_ref, acc):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            acc[:] = jnp.zeros_like(acc)
+        acc[:] = acc[:] + x_ref[:, :, 0, :].sum(axis=(0, 1))
+        o_ref[:, :, 0, :] = x_ref[:, :, 0, :]
+
+    try:
+        fn = pl.pallas_call(
+            k_accum,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((H, H, 1, B), lambda i: (0, 0, i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((H, H, 1, B), lambda i: (0, 0, i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((H, H, 2, B), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((B,), jnp.float32)],
+        )
+        fn(x).block_until_ready()
+        print("scratch-accum: OK")
+    except Exception as e:
+        print(f"scratch-accum: FAIL — {str(e)[:180]}")
+
+    # (e) free-transpose check in XLA-land: is transpose(0->batch-last) a
+    # bitcast for conv-produced activations? just verify shapes flow.
+    y = jnp.transpose(x, (1, 2, 3, 0))
+    print("xla transpose ok", y.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Compressed-schedule convergence smoke (SURVEY.md §4 gap-fill).
+
+The reference's acceptance test is convergence itself: 90 epochs of
+step-decay (x0.1 at 30/60) to ``--desired-acc`` (imagenet_ddp.py:224-236,
+README --desired-acc 0.75). A full ImageNet run is out of scope for CI, so
+this compresses the *schedule* rather than replacing it: a separable
+3-class fixture trained through 65 real epochs (tiny ones — 2 steps each)
+descends the exact reference LR trajectory through two decay steps, and
+must actually converge (train top-1 >= 95%, loss < 0.2) while the logged
+LR matches lr0 * 0.1^(epoch // 30) at every epoch.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dptpu.config import Config
+from dptpu.train import fit
+
+
+@pytest.fixture(scope="module")
+def separable_imagenet(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sepimg")
+    rng = np.random.RandomState(0)
+    for split, per_class in [("train", 16), ("val", 8)]:
+        for cls in range(3):
+            d = root / split / f"class{cls}"
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                base = np.full((40, 40, 3), 50 + 80 * cls, np.uint8)
+                noise = rng.randint(0, 40, base.shape, dtype=np.uint8)
+                Image.fromarray(base + noise).save(d / f"{i}.png")
+    return str(root)
+
+
+def test_step_decay_schedule_descends_and_converges(separable_imagenet,
+                                                    tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    lr0 = 0.05
+    cfg = Config(
+        data=separable_imagenet,
+        arch="resnet18",
+        epochs=65,
+        batch_size=24,
+        lr=lr0,
+        workers=2,
+        print_freq=100,
+        seed=3,
+        gpu=0,  # single-device: the schedule smoke needs epochs, not a mesh
+    )
+    result = fit(cfg, image_size=32, verbose=False)
+    hist = result["history"]
+    assert len(hist) == 65
+
+    # the exact reference trajectory: lr = lr0 * 0.1^(epoch//30)
+    # (imagenet_ddp.py:374-378), read back from the logged metrics
+    for h in hist:
+        want = lr0 * (0.1 ** (h["epoch"] // 30))
+        assert h["train_lr"] == pytest.approx(want, rel=1e-5), h["epoch"]
+
+    # convergence through the decays: by the last stage the model must
+    # have actually learned the separable data
+    tail = hist[-5:]
+    assert max(h["train_top1"] for h in tail) >= 95.0
+    assert min(h["train_loss"] for h in tail) < 0.2
+    # and the post-decay stage must not be *worse* than the first stage
+    assert np.mean([h["train_loss"] for h in tail]) < np.mean(
+        [h["train_loss"] for h in hist[:5]]
+    )
