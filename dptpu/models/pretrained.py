@@ -57,7 +57,7 @@ _SQUEEZE_FIRE_IDX = {
 def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
     """Map a dptpu module path (tuple of names) to the torch module path."""
     head = mod[0]
-    if arch.startswith("resnet"):
+    if arch.startswith(("resnet", "wide_resnet", "resnext")):
         if head.startswith("layer"):
             layer, block = head.split("_block")
             sub = {"downsample_conv": "downsample.0",

@@ -80,19 +80,27 @@ class Bottleneck(nn.Module):
     conv: Callable
     norm: Callable
     expansion: int = 4
+    # torchvision's width generalization: the 1x1/3x3 pair runs at
+    # int(planes * base_width / 64) * groups channels, the 3x3 grouped —
+    # (64, 1) is plain ResNet, (128, 1) wide_resnet*_2, (4, 32)
+    # resnext50_32x4d, (8, 32) resnext101_32x8d
+    base_width: int = 64
+    groups: int = 1
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.planes, (1, 1), name="conv1")(x)
+        width = int(self.planes * self.base_width / 64) * self.groups
+        y = self.conv(width, (1, 1), name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
         # stride on the 3x3 conv: torchvision ResNet v1.5
         y = self.conv(
-            self.planes,
+            width,
             (3, 3),
             strides=(self.stride, self.stride),
             padding=((1, 1), (1, 1)),
+            feature_group_count=self.groups,
             name="conv2",
         )(y)
         y = self.norm(name="bn2")(y)
@@ -182,6 +190,10 @@ class ResNet(nn.Module):
     # Opt-in (DPTPU_FUSED_STEM=1): correct and parity-tested, but measured
     # slower than XLA's native stem on v5e Mosaic — see PERF.md.
     fused_stem: bool = False
+    # Bottleneck width generalization (see Bottleneck): plain ResNet is
+    # (64, 1); wide_resnet*_2 use base_width 128; resnext* use groups 32.
+    base_width: int = 64
+    groups: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -223,6 +235,11 @@ class ResNet(nn.Module):
             x = norm(name="bn1")(x)
             x = nn.relu(x)
             x = max_pool_same_as_torch(x, 3, 2, 1)
+        width_kw = (
+            {"base_width": self.base_width, "groups": self.groups}
+            if self.block_cls is Bottleneck
+            else {}
+        )
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 x = self.block_cls(
@@ -231,6 +248,7 @@ class ResNet(nn.Module):
                     conv=conv,
                     norm=norm,
                     name=f"layer{i + 1}_block{j}",
+                    **width_kw,
                 )(x)
         x = x.mean(axis=(1, 2))  # AdaptiveAvgPool2d((1,1)) + flatten
         fan_in = x.shape[-1]
@@ -272,3 +290,23 @@ def resnet101(**kw):
 @register_model
 def resnet152(**kw):
     return _resnet([3, 8, 36, 3], Bottleneck, **kw)
+
+
+@register_model
+def wide_resnet50_2(**kw):
+    return _resnet([3, 4, 6, 3], Bottleneck, base_width=128, **kw)
+
+
+@register_model
+def wide_resnet101_2(**kw):
+    return _resnet([3, 4, 23, 3], Bottleneck, base_width=128, **kw)
+
+
+@register_model
+def resnext50_32x4d(**kw):
+    return _resnet([3, 4, 6, 3], Bottleneck, base_width=4, groups=32, **kw)
+
+
+@register_model
+def resnext101_32x8d(**kw):
+    return _resnet([3, 4, 23, 3], Bottleneck, base_width=8, groups=32, **kw)

@@ -33,6 +33,10 @@ TORCHVISION_PARAM_COUNTS = {
     "densenet201": 20_013_928,
     "squeezenet1_0": 1_248_424,
     "squeezenet1_1": 1_235_496,
+    "wide_resnet50_2": 68_883_240,
+    "wide_resnet101_2": 126_886_696,
+    "resnext50_32x4d": 25_028_904,
+    "resnext101_32x8d": 88_791_336,
 }
 
 
@@ -68,6 +72,18 @@ def test_alexnet_param_count():
 @pytest.mark.parametrize("name", ["vgg11", "vgg16", "vgg16_bn", "vgg19_bn"])
 def test_vgg_param_counts(name):
     _, variables = _init(name, image=224)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+@pytest.mark.parametrize("name", ["wide_resnet50_2", "resnext50_32x4d"])
+def test_wide_resnext_param_counts(name):
+    _, variables = _init(name)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+@pytest.mark.parametrize("name", ["wide_resnet101_2", "resnext101_32x8d"])
+def test_wide_resnext_param_counts_slow(name):
+    _, variables = _init(name)
     assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
 
 

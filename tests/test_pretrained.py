@@ -57,7 +57,8 @@ def _fake_torch_sd(arch, variables, rng):
 
 
 @pytest.mark.parametrize("arch", ["resnet18", "alexnet", "densenet121",
-                                  "squeezenet1_0", "vgg11_bn"])
+                                  "squeezenet1_0", "vgg11_bn",
+                                  "resnext50_32x4d", "wide_resnet50_2"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
