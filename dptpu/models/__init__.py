@@ -7,6 +7,7 @@ module-dict discovery, imagenet_ddp.py:19-21). ``model_names()`` and
 
 from dptpu.models import alexnet as _alexnet  # noqa: F401
 from dptpu.models import densenet as _densenet  # noqa: F401
+from dptpu.models import mobilenet as _mobilenet  # noqa: F401
 from dptpu.models import resnet as _resnet  # noqa: F401
 from dptpu.models import squeezenet as _squeezenet  # noqa: F401
 from dptpu.models import vgg as _vgg  # noqa: F401

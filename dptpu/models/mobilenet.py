@@ -1,0 +1,149 @@
+"""MobileNetV2, torchvision-architecture-exact, NHWC.
+
+Reachable through the discovery registry like every torchvision callable
+(imagenet_ddp.py:19-21, ``-a mobilenet_v2``). Fresh Flax build of
+torchvision's ``mobilenetv2.py``:
+
+* stem 3x3/2 ConvBNReLU6 (32);
+* 17 inverted residuals — 1x1 expand (ratio 6, skipped at ratio 1) ->
+  3x3 depthwise (``feature_group_count = hidden``) -> 1x1 linear
+  projection, residual add when stride 1 and matching channels;
+* head 1x1 ConvBNReLU6 to 1280 -> global average pool -> Dropout(0.2) ->
+  Linear. All activations are ReLU6 (clip at 6 preserves low-precision
+  ranges — convenient for bf16 too).
+
+Channel counts go through torchvision's ``_make_divisible`` (divisor 8).
+Init matches: conv kernels kaiming-normal fan-out, BN 1/0, classifier
+N(0, 0.01) with zero bias. Parameter count (3,504,872) locked in
+tests/test_models.py.
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import kaiming_normal_fan_out
+from dptpu.models.registry import register_model
+
+# (expand_ratio, out_channels, repeats, first_stride)
+_SETTINGS = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:  # never round down by more than 10%
+        new_v += divisor
+    return int(new_v)
+
+
+class InvertedResidual(nn.Module):
+    out_ch: int
+    stride: int
+    expand_ratio: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        hidden = int(round(inp * self.expand_ratio))
+        y = x
+        idx = 0
+        if self.expand_ratio != 1:
+            y = self.conv(hidden, (1, 1), name=f"conv_{idx}")(y)
+            y = self.norm(name=f"bn_{idx}")(y)
+            y = nn.relu6(y)
+            idx += 1
+        y = self.conv(
+            hidden, (3, 3),
+            strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)),
+            feature_group_count=hidden,
+            name=f"conv_{idx}",
+        )(y)
+        y = self.norm(name=f"bn_{idx}")(y)
+        y = nn.relu6(y)
+        y = self.conv(self.out_ch, (1, 1), name=f"conv_{idx + 1}")(y)
+        y = self.norm(name=f"bn_{idx + 1}")(y)
+        if self.stride == 1 and inp == self.out_ch:
+            y = (x + y).astype(y.dtype)
+        return y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_normal_fan_out,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        in_ch = _make_divisible(32 * self.width_mult)
+        last_ch = _make_divisible(1280 * max(1.0, self.width_mult))
+        x = conv(in_ch, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu6(x)
+        block = 0
+        for t, c, n, s in _SETTINGS:
+            out_ch = _make_divisible(c * self.width_mult)
+            for i in range(n):
+                x = InvertedResidual(
+                    out_ch=out_ch,
+                    stride=s if i == 0 else 1,
+                    expand_ratio=t,
+                    conv=conv,
+                    norm=norm,
+                    name=f"block{block}",
+                )(x)
+                block += 1
+        x = conv(last_ch, (1, 1), name="head_conv")(x)
+        x = norm(name="head_bn")(x)
+        x = nn.relu6(x)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.01),
+            bias_init=nn.initializers.zeros,
+            name="classifier",
+        )(x)
+        return x
+
+
+@register_model
+def mobilenet_v2(**kw):
+    return MobileNetV2(**kw)

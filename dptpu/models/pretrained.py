@@ -76,6 +76,31 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
         if head.startswith("transition"):
             return f"features.{head}.{mod[1]}"
         return head  # classifier
+    if arch == "mobilenet_v2":
+        # torchvision Sequential: features.0 stem ConvBNReLU, features.1..17
+        # inverted residuals, features.18 head, classifier.1 Linear
+        if head == "stem_conv":
+            return "features.0.0"
+        if head == "stem_bn":
+            return "features.0.1"
+        if head == "head_conv":
+            return "features.18.0"
+        if head == "head_bn":
+            return "features.18.1"
+        if head.startswith("block"):
+            k = int(head[5:])
+            kind, i = mod[1].split("_")
+            i = int(i)
+            expand = k != 0  # only the first block runs expand_ratio 1
+            if expand:
+                sub = {("conv", 0): "conv.0.0", ("bn", 0): "conv.0.1",
+                       ("conv", 1): "conv.1.0", ("bn", 1): "conv.1.1",
+                       ("conv", 2): "conv.2", ("bn", 2): "conv.3"}[(kind, i)]
+            else:
+                sub = {("conv", 0): "conv.0.0", ("bn", 0): "conv.0.1",
+                       ("conv", 1): "conv.1", ("bn", 1): "conv.2"}[(kind, i)]
+            return f"features.{k + 1}.{sub}"
+        return "classifier.1"
     if arch.startswith("squeezenet"):
         version = arch.split("squeezenet")[1]
         if head == "conv1":

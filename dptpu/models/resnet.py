@@ -235,11 +235,16 @@ class ResNet(nn.Module):
             x = norm(name="bn1")(x)
             x = nn.relu(x)
             x = max_pool_same_as_torch(x, 3, 2, 1)
-        width_kw = (
-            {"base_width": self.base_width, "groups": self.groups}
-            if self.block_cls is Bottleneck
-            else {}
-        )
+        if self.block_cls is Bottleneck:
+            width_kw = {"base_width": self.base_width, "groups": self.groups}
+        else:
+            if self.groups != 1 or self.base_width != 64:
+                # torchvision raises the same way: BasicBlock has no width
+                # generalization (only Bottleneck archs are wide/grouped)
+                raise ValueError(
+                    "BasicBlock only supports groups=1 and base_width=64"
+                )
+            width_kw = {}
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 x = self.block_cls(

@@ -146,7 +146,8 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # fp32 — the Apex flag's strictest reading (imagenet_ddp_apex.py:93).
     keep_bn_fp32 = str(cfg.keep_batchnorm_fp32).lower() in ("true", "1")
     want_s2d = _os_environ_flag("DPTPU_S2D")
-    use_s2d = want_s2d and cfg.arch.startswith("resnet") and image_size % 2 == 0
+    _resnet_family = cfg.arch.startswith(("resnet", "wide_resnet", "resnext"))
+    use_s2d = want_s2d and _resnet_family and image_size % 2 == 0
     if want_s2d and not use_s2d and verbose:
         print(
             f"=> DPTPU_S2D ignored: requires a resnet arch and even input "
@@ -168,8 +169,8 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # fused Pallas stem (bn1+relu+maxpool custom-VJP region): opt-in,
         # parity-tested; slower than XLA's stem on v5e Mosaic (PERF.md)
         **({"fused_stem": True}
-           if _os_environ_flag("DPTPU_FUSED_STEM")
-           and cfg.arch.startswith("resnet") else {}),
+           if _os_environ_flag("DPTPU_FUSED_STEM") and _resnet_family
+           else {}),
     )
     if cfg.variant == "apex":
         schedule = make_warmup_step_decay_schedule(derived.scaled_lr, steps_per_epoch)

@@ -37,6 +37,7 @@ TORCHVISION_PARAM_COUNTS = {
     "wide_resnet101_2": 126_886_696,
     "resnext50_32x4d": 25_028_904,
     "resnext101_32x8d": 88_791_336,
+    "mobilenet_v2": 3_504_872,
 }
 
 
@@ -85,6 +86,16 @@ def test_wide_resnext_param_counts(name):
 def test_wide_resnext_param_counts_slow(name):
     _, variables = _init(name)
     assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+def test_mobilenet_v2_param_count_and_forward():
+    m = create_model("mobilenet_v2", num_classes=9)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    m1000 = create_model("mobilenet_v2")
+    v1000 = m1000.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    assert _count(v1000["params"]) == TORCHVISION_PARAM_COUNTS["mobilenet_v2"]
+    out = m.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 9)
 
 
 @pytest.mark.parametrize("name", ["densenet121"])

@@ -28,7 +28,9 @@ from dptpu.models.pretrained import (
 def _init_vars(arch, num_classes=10, image=None):
     if image is None:
         # vgg/alexnet/squeezenet need full-size inputs (fixed-grid pools)
-        image = 32 if arch.startswith(("resnet", "densenet")) else 224
+        image = (32 if arch.startswith(("resnet", "densenet", "mobilenet",
+                                         "wide_resnet", "resnext"))
+                 else 224)
     model = create_model(arch, num_classes=num_classes)
     v = model.init(jax.random.PRNGKey(0),
                    jnp.zeros((1, image, image, 3)), train=False)
@@ -58,7 +60,8 @@ def _fake_torch_sd(arch, variables, rng):
 
 @pytest.mark.parametrize("arch", ["resnet18", "alexnet", "densenet121",
                                   "squeezenet1_0", "vgg11_bn",
-                                  "resnext50_32x4d", "wide_resnet50_2"])
+                                  "resnext50_32x4d", "wide_resnet50_2",
+                                  "mobilenet_v2"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
