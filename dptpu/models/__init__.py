@@ -7,8 +7,10 @@ module-dict discovery, imagenet_ddp.py:19-21). ``model_names()`` and
 
 from dptpu.models import alexnet as _alexnet  # noqa: F401
 from dptpu.models import densenet as _densenet  # noqa: F401
+from dptpu.models import mnasnet as _mnasnet  # noqa: F401
 from dptpu.models import mobilenet as _mobilenet  # noqa: F401
 from dptpu.models import resnet as _resnet  # noqa: F401
+from dptpu.models import shufflenet as _shufflenet  # noqa: F401
 from dptpu.models import squeezenet as _squeezenet  # noqa: F401
 from dptpu.models import vgg as _vgg  # noqa: F401
 from dptpu.models.registry import create_model, model_names, register_model

@@ -1,0 +1,165 @@
+"""MNASNet (0_5 / 0_75 / 1_0 / 1_3), torchvision-exact, NHWC.
+
+Registry-discoverable like the rest (imagenet_ddp.py:19-21, e.g.
+``-a mnasnet1_0``). Fresh Flax build of torchvision's ``mnasnet.py``:
+
+* stem 3x3/2 conv BN ReLU -> depthwise-separable (dw3x3 + pw) block;
+* six stacks of inverted residuals with the NAS-chosen kernel sizes and
+  expansions: (k3 t3 n3 s2), (k5 t3 n3 s2), (k5 t6 n3 s2), (k3 t6 n2 s1),
+  (k5 t6 n4 s2), (k3 t6 n1 s1);
+* head 1x1 conv to 1280 -> global average pool -> Dropout(0.2) -> Linear.
+
+Depths scale by alpha through ``_round_to_multiple_of(d * alpha, 8)``.
+torchvision runs these BNs with momentum 0.0003 (flax EMA decay 0.9997) —
+preserved, it matters for eval parity on short runs. Init matches:
+convs kaiming-normal fan-out, classifier kaiming-uniform over fan_out
+with sigmoid gain (bound sqrt(3 / fan_out)). Param counts locked in
+tests/test_models.py (mnasnet1_0 = 4,383,312).
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dptpu.models.layers import kaiming_normal_fan_out
+from dptpu.models.registry import register_model
+
+_BN_DECAY = 0.9997  # torch _BN_MOMENTUM = 1 - 0.9997
+# (kernel, expansion, repeats, first_stride) per stack
+_STACKS = ((3, 3, 3, 2), (5, 3, 3, 2), (5, 6, 3, 2),
+           (3, 6, 2, 1), (5, 6, 4, 2), (3, 6, 1, 1))
+_BASE_DEPTHS = (32, 16, 24, 40, 80, 96, 192, 320)
+
+
+def _round_to_multiple_of(val, divisor=8):
+    new_val = max(divisor, int(val + divisor / 2) // divisor * divisor)
+    return new_val if new_val >= 0.9 * val else new_val + divisor
+
+
+def _depths(alpha):
+    return [_round_to_multiple_of(d * alpha) for d in _BASE_DEPTHS]
+
+
+def _classifier_kernel_init(key, shape, dtype=jnp.float32):
+    # torchvision: kaiming_uniform_(mode="fan_out", nonlinearity="sigmoid")
+    # on the (out, in) torch weight -> bound sqrt(3 / fan_out); flax shape
+    # is (in, out) so fan_out = shape[-1]
+    bound = np.sqrt(3.0 / shape[-1])
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class MnasInvertedResidual(nn.Module):
+    out_ch: int
+    kernel: int
+    stride: int
+    expansion: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        mid = inp * self.expansion
+        k, p = self.kernel, self.kernel // 2
+        y = self.conv(mid, (1, 1), name="pw1")(x)
+        y = nn.relu(self.norm(name="pw1_bn")(y))
+        y = self.conv(
+            mid, (k, k), strides=(self.stride, self.stride),
+            padding=((p, p), (p, p)), feature_group_count=mid, name="dw",
+        )(y)
+        y = nn.relu(self.norm(name="dw_bn")(y))
+        y = self.conv(self.out_ch, (1, 1), name="pw2")(y)
+        y = self.norm(name="pw2_bn")(y)
+        if self.stride == 1 and inp == self.out_ch:
+            y = (x + y).astype(y.dtype)
+        return y
+
+
+class MNASNet(nn.Module):
+    alpha: float = 1.0
+    num_classes: int = 1000
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_normal_fan_out,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=_BN_DECAY,
+            epsilon=1e-5,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        d = _depths(self.alpha)
+        x = conv(d[0], (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="stem_conv")(x)
+        x = nn.relu(norm(name="stem_bn")(x))
+        # depthwise-separable block
+        x = conv(d[0], (3, 3), padding=((1, 1), (1, 1)),
+                 feature_group_count=d[0], name="sep_dw")(x)
+        x = nn.relu(norm(name="sep_dw_bn")(x))
+        x = conv(d[1], (1, 1), name="sep_pw")(x)
+        x = norm(name="sep_pw_bn")(x)
+        block = 0
+        for stack, (k, t, n, s) in enumerate(_STACKS):
+            out_ch = d[stack + 2]
+            for i in range(n):
+                x = MnasInvertedResidual(
+                    out_ch=out_ch,
+                    kernel=k,
+                    stride=s if i == 0 else 1,
+                    expansion=t,
+                    conv=conv,
+                    norm=norm,
+                    name=f"block{block}",
+                )(x)
+                block += 1
+        x = conv(1280, (1, 1), name="head_conv")(x)
+        x = nn.relu(norm(name="head_bn")(x))
+        x = x.mean(axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=_classifier_kernel_init,
+            bias_init=nn.initializers.zeros,  # torchvision zeroes it
+            name="classifier",
+        )(x)
+        return x
+
+
+@register_model
+def mnasnet0_5(**kw):
+    return MNASNet(alpha=0.5, **kw)
+
+
+@register_model
+def mnasnet0_75(**kw):
+    return MNASNet(alpha=0.75, **kw)
+
+
+@register_model
+def mnasnet1_0(**kw):
+    return MNASNet(alpha=1.0, **kw)
+
+
+@register_model
+def mnasnet1_3(**kw):
+    return MNASNet(alpha=1.3, **kw)

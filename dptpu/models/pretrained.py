@@ -101,6 +101,44 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
                        ("conv", 1): "conv.1", ("bn", 1): "conv.2"}[(kind, i)]
             return f"features.{k + 1}.{sub}"
         return "classifier.1"
+    if arch.startswith("shufflenet_v2"):
+        # torch: conv1/conv5 are Sequential(conv, bn); units are
+        # stage{s}.{i} with branch1 = (dw, bn, pw, bn) and branch2 =
+        # (pw, bn, relu, dw, bn, pw, bn, relu)
+        if head in ("conv1", "conv5"):
+            return f"{head}.0"
+        if head in ("conv1_bn", "conv5_bn"):
+            return f"{head[:5]}.1"
+        if head == "fc":
+            return "fc"
+        stage, unit = head.split("_unit")
+        sub = {"branch1_dw": "branch1.0", "branch1_dw_bn": "branch1.1",
+               "branch1_pw": "branch1.2", "branch1_pw_bn": "branch1.3",
+               "branch2_pw1": "branch2.0", "branch2_pw1_bn": "branch2.1",
+               "branch2_dw": "branch2.3", "branch2_dw_bn": "branch2.4",
+               "branch2_pw2": "branch2.5", "branch2_pw2_bn": "branch2.6"}[mod[1]]
+        return f"{stage}.{unit}.{sub}"
+    if arch.startswith("mnasnet"):
+        # torch: one flat `layers` Sequential — 0/1 stem conv+bn, 3/4 sep
+        # dw+bn, 6/7 sep pw+bn, 8..13 the six stacks of inverted residuals
+        # (each block a Sequential named `layers` again), 14/15 head
+        flat = {"stem_conv": "layers.0", "stem_bn": "layers.1",
+                "sep_dw": "layers.3", "sep_dw_bn": "layers.4",
+                "sep_pw": "layers.6", "sep_pw_bn": "layers.7",
+                "head_conv": "layers.14", "head_bn": "layers.15",
+                "classifier": "classifier.1"}
+        if head in flat:
+            return flat[head]
+        k = int(head[5:])  # block index -> (stack, index-in-stack)
+        repeats = (3, 3, 3, 2, 4, 1)
+        stack = 0
+        while k >= repeats[stack]:
+            k -= repeats[stack]
+            stack += 1
+        sub = {"pw1": "layers.0", "pw1_bn": "layers.1",
+               "dw": "layers.3", "dw_bn": "layers.4",
+               "pw2": "layers.6", "pw2_bn": "layers.7"}[mod[1]]
+        return f"layers.{8 + stack}.{k}.{sub}"
     if arch.startswith("squeezenet"):
         version = arch.split("squeezenet")[1]
         if head == "conv1":

@@ -1,0 +1,163 @@
+"""ShuffleNetV2 (x0_5 / x1_0 / x1_5 / x2_0), torchvision-exact, NHWC.
+
+Discovered via the registry like the rest of the zoo
+(imagenet_ddp.py:19-21, e.g. ``-a shufflenet_v2_x1_0``). Fresh Flax build
+of torchvision's ``shufflenetv2.py``:
+
+* stem 3x3/2 conv (24) BN ReLU -> 3x3/2 max pool;
+* three stages of (4, 8, 4) units. A stride-2 unit runs both branches on
+  the full input (branch1: dw3x3/2 + pw; branch2: pw + dw3x3/2 + pw) and
+  concatenates; a stride-1 unit splits channels in half, transforms one
+  half, concatenates back. Every unit ends with channel_shuffle(groups=2)
+  — in NHWC that is a reshape/transpose on the minor dim, which XLA folds
+  into the surrounding ops;
+* 1x1 conv to the final width -> global average pool -> fc.
+
+torchvision applies no custom init here, so convs (bias-free) and the fc
+use torch defaults (kaiming-uniform(a=sqrt 5) == U(+-1/sqrt fan_in)).
+Param counts locked in tests/test_models.py (x1_0 = 2,278,604).
+"""
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    max_pool_same_as_torch,
+    torch_default_bias_init,
+    torch_default_kernel_init,
+)
+from dptpu.models.registry import register_model
+
+_STAGE_REPEATS = (4, 8, 4)
+_STAGE_OUT = {
+    "x0_5": (24, 48, 96, 192, 1024),
+    "x1_0": (24, 116, 232, 464, 1024),
+    "x1_5": (24, 176, 352, 704, 1024),
+    "x2_0": (24, 244, 488, 976, 2048),
+}
+
+
+def channel_shuffle(x, groups: int = 2):
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    x = x.transpose(0, 1, 2, 4, 3)
+    return x.reshape(b, h, w, c)
+
+
+class ShuffleUnit(nn.Module):
+    out_ch: int
+    stride: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        branch_ch = self.out_ch // 2
+        if self.stride == 1:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+        else:
+            x1 = x2 = x
+            # branch1 only exists for stride-2 units
+            b1 = self.conv(
+                x1.shape[-1], (3, 3), strides=(self.stride, self.stride),
+                padding=((1, 1), (1, 1)), feature_group_count=x1.shape[-1],
+                name="branch1_dw",
+            )(x1)
+            b1 = self.norm(name="branch1_dw_bn")(b1)
+            b1 = self.conv(branch_ch, (1, 1), name="branch1_pw")(b1)
+            b1 = self.norm(name="branch1_pw_bn")(b1)
+            x1 = nn.relu(b1)
+
+        y = self.conv(branch_ch, (1, 1), name="branch2_pw1")(x2)
+        y = self.norm(name="branch2_pw1_bn")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            branch_ch, (3, 3), strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)), feature_group_count=branch_ch,
+            name="branch2_dw",
+        )(y)
+        y = self.norm(name="branch2_dw_bn")(y)
+        y = self.conv(branch_ch, (1, 1), name="branch2_pw2")(y)
+        y = self.norm(name="branch2_pw2_bn")(y)
+        y = nn.relu(y)
+        return channel_shuffle(jnp.concatenate([x1, y], axis=-1))
+
+
+class ShuffleNetV2(nn.Module):
+    width: str = "x1_0"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=torch_default_kernel_init,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        chans = _STAGE_OUT[self.width]
+        x = conv(chans[0], (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="conv1")(x)
+        x = norm(name="conv1_bn")(x)
+        x = nn.relu(x)
+        x = max_pool_same_as_torch(x, 3, 2, 1)
+        for stage, repeats in enumerate(_STAGE_REPEATS):
+            out_ch = chans[stage + 1]
+            for i in range(repeats):
+                x = ShuffleUnit(
+                    out_ch=out_ch,
+                    stride=2 if i == 0 else 1,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{stage + 2}_unit{i}",
+                )(x)
+        x = conv(chans[4], (1, 1), name="conv5")(x)
+        x = norm(name="conv5_bn")(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=torch_default_kernel_init,
+            bias_init=torch_default_bias_init(chans[4]),
+            name="fc",
+        )(x)
+        return x
+
+
+@register_model
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(width="x0_5", **kw)
+
+
+@register_model
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(width="x1_0", **kw)
+
+
+@register_model
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(width="x1_5", **kw)
+
+
+@register_model
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(width="x2_0", **kw)

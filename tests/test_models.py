@@ -38,6 +38,10 @@ TORCHVISION_PARAM_COUNTS = {
     "resnext50_32x4d": 25_028_904,
     "resnext101_32x8d": 88_791_336,
     "mobilenet_v2": 3_504_872,
+    "shufflenet_v2_x0_5": 1_366_792,
+    "shufflenet_v2_x1_0": 2_278_604,
+    "mnasnet0_5": 2_218_512,
+    "mnasnet1_0": 4_383_312,
 }
 
 
@@ -86,6 +90,27 @@ def test_wide_resnext_param_counts(name):
 def test_wide_resnext_param_counts_slow(name):
     _, variables = _init(name)
     assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+@pytest.mark.parametrize("name", ["shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+                                  "mnasnet0_5", "mnasnet1_0"])
+def test_shufflenet_mnasnet_param_counts(name):
+    _, variables = _init(name)
+    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+
+
+def test_shufflenet_forward_and_channel_shuffle():
+    from dptpu.models.shufflenet import channel_shuffle
+
+    x = jnp.arange(8.0).reshape(1, 1, 1, 8)
+    # groups=2: [0..3 | 4..7] interleaves to [0,4,1,5,2,6,3,7]
+    np.testing.assert_array_equal(
+        np.asarray(channel_shuffle(x)).ravel(), [0, 4, 1, 5, 2, 6, 3, 7]
+    )
+    m = create_model("shufflenet_v2_x0_5", num_classes=6)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    out = m.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 6)
 
 
 def test_mobilenet_v2_param_count_and_forward():

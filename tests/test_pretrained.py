@@ -29,7 +29,8 @@ def _init_vars(arch, num_classes=10, image=None):
     if image is None:
         # vgg/alexnet/squeezenet need full-size inputs (fixed-grid pools)
         image = (32 if arch.startswith(("resnet", "densenet", "mobilenet",
-                                         "wide_resnet", "resnext"))
+                                         "wide_resnet", "resnext",
+                                         "shufflenet", "mnasnet"))
                  else 224)
     model = create_model(arch, num_classes=num_classes)
     v = model.init(jax.random.PRNGKey(0),
@@ -61,7 +62,8 @@ def _fake_torch_sd(arch, variables, rng):
 @pytest.mark.parametrize("arch", ["resnet18", "alexnet", "densenet121",
                                   "squeezenet1_0", "vgg11_bn",
                                   "resnext50_32x4d", "wide_resnet50_2",
-                                  "mobilenet_v2"])
+                                  "mobilenet_v2", "shufflenet_v2_x1_0",
+                                  "mnasnet1_0"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
