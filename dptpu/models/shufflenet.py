@@ -19,7 +19,7 @@ Param counts locked in tests/test_models.py (x1_0 = 2,278,604).
 """
 
 from functools import partial
-from typing import Any, Optional, Sequence
+from typing import Any, Optional
 
 import jax.numpy as jnp
 from flax import linen as nn

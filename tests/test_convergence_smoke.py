@@ -41,7 +41,7 @@ def test_step_decay_schedule_descends_and_converges(separable_imagenet,
         data=separable_imagenet,
         arch="resnet18",
         epochs=65,
-        batch_size=24,
+        batch_size=48,  # one step per epoch: the schedule, not the steps, is under test
         lr=lr0,
         workers=2,
         print_freq=100,

@@ -42,9 +42,14 @@ def test_two_process_training_agrees(tmp_path):
         for rank in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=280)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
 
@@ -111,3 +116,45 @@ def test_hierarchical_mesh_rejects_ragged_hosts():
     devs = [_FakeDev(0, 0), _FakeDev(1, 0), _FakeDev(2, 1)]
     with pytest.raises(ValueError, match="equal chips"):
         make_mesh(devices=devs)
+
+
+def test_two_process_full_fit_agrees(tmp_path):
+    """The COMPLETE fit() path on a 2-host pod: CLI config, rendezvous,
+    hierarchical mesh, sharded train loaders, full-val-on-every-host with
+    the count divisor, chief-only checkpoint. Both hosts must agree on
+    every logged metric, the val count must equal len(val) (counted once
+    despite two hosts feeding the full set), and only rank 0 writes."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_fit_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(rank), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo_root,
+        )
+        for rank in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=280)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    metrics = {0: [], 1: []}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RANK") and "EPOCH" in line:
+                rank = int(line.split()[0][4:])
+                metrics[rank].append(line.split(None, 1)[1])
+    assert metrics[0] and metrics[0] == metrics[1]  # bitwise-agreeing logs
+    # full-val mode: synthetic:64 -> val set 6 samples, counted ONCE
+    assert "vcount=6.0" in metrics[0][0]
+    # chief-only checkpoint in each rank's private cwd
+    assert (tmp_path / "rank0" / "checkpoint.pth.tar").exists()
+    assert not (tmp_path / "rank1" / "checkpoint.pth.tar").exists()
