@@ -42,6 +42,10 @@ TORCHVISION_PARAM_COUNTS = {
     "shufflenet_v2_x1_0": 2_278_604,
     "mnasnet0_5": 2_218_512,
     "mnasnet1_0": 4_383_312,
+    "shufflenet_v2_x1_5": 3_503_624,
+    "shufflenet_v2_x2_0": 7_393_996,
+    "mnasnet0_75": 3_170_208,
+    "mnasnet1_3": 6_282_256,
 }
 
 
@@ -57,46 +61,36 @@ def _count(tree):
     return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
 
 
-@pytest.mark.parametrize("name", ["resnet18", "resnet50", "resnet152"])
-def test_resnet_param_counts(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+def _param_count(name, image=64):
+    """Parameter count via jax.eval_shape — exact (counts need shapes
+    only) and ~100x faster than materializing a 100M-param init on CPU."""
+    model = create_model(name)
+    shapes = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, image, image, 3), jnp.float32),
+    )
+    return _count(shapes["params"])
 
 
-@pytest.mark.parametrize("name", ["resnet34", "resnet101"])
-def test_resnet_param_counts_slow(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+@pytest.mark.parametrize("name", sorted(TORCHVISION_PARAM_COUNTS))
+def test_param_counts_match_torchvision(name):
+    image = 224 if name.startswith(("alexnet", "vgg", "squeezenet")) else 64
+    assert _param_count(name, image) == TORCHVISION_PARAM_COUNTS[name]
 
 
-def test_alexnet_param_count():
-    _, variables = _init("alexnet", image=224)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS["alexnet"]
-
-
-@pytest.mark.parametrize("name", ["vgg11", "vgg16", "vgg16_bn", "vgg19_bn"])
-def test_vgg_param_counts(name):
-    _, variables = _init(name, image=224)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
-
-
-@pytest.mark.parametrize("name", ["wide_resnet50_2", "resnext50_32x4d"])
-def test_wide_resnext_param_counts(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
-
-
-@pytest.mark.parametrize("name", ["wide_resnet101_2", "resnext101_32x8d"])
-def test_wide_resnext_param_counts_slow(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
-
-
-@pytest.mark.parametrize("name", ["shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
-                                  "mnasnet0_5", "mnasnet1_0"])
-def test_shufflenet_mnasnet_param_counts(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
+@pytest.mark.parametrize("name,image", [
+    ("vgg11_bn", 224), ("mnasnet0_5", 64), ("resnext50_32x4d", 64),
+    ("wide_resnet50_2", 64), ("alexnet", 224),
+])
+def test_family_concrete_init_and_forward(name, image):
+    """One CONCRETE init+forward per family not covered elsewhere:
+    eval_shape-based count tests never execute initializers, so a
+    value-level init bug (NaN std, concrete-only dtype path) needs this."""
+    m = create_model(name, num_classes=5)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    out = m.apply(v, jnp.zeros((2, image, image, 3)), train=False)
+    assert out.shape == (2, 5)
+    assert np.isfinite(np.asarray(out)).all()
 
 
 def test_shufflenet_forward_and_channel_shuffle():
@@ -116,30 +110,8 @@ def test_shufflenet_forward_and_channel_shuffle():
 def test_mobilenet_v2_param_count_and_forward():
     m = create_model("mobilenet_v2", num_classes=9)
     v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
-    m1000 = create_model("mobilenet_v2")
-    v1000 = m1000.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
-    assert _count(v1000["params"]) == TORCHVISION_PARAM_COUNTS["mobilenet_v2"]
     out = m.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
     assert out.shape == (2, 9)
-
-
-@pytest.mark.parametrize("name", ["densenet121"])
-def test_densenet_param_counts(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
-
-
-@pytest.mark.parametrize("name", ["densenet161", "densenet169", "densenet201"])
-def test_densenet_param_counts_slow(name):
-    _, variables = _init(name)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
-
-
-@pytest.mark.parametrize("name", ["squeezenet1_0", "squeezenet1_1"])
-def test_squeezenet_param_counts(name):
-    # squeezenet's unpadded stem conv + ceil-mode pools need >= 224 inputs
-    _, variables = _init(name, image=224)
-    assert _count(variables["params"]) == TORCHVISION_PARAM_COUNTS[name]
 
 
 def test_squeezenet_ceil_mode_pool_shapes():

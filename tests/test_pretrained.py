@@ -33,8 +33,12 @@ def _init_vars(arch, num_classes=10, image=None):
                                          "shufflenet", "mnasnet"))
                  else 224)
     model = create_model(arch, num_classes=num_classes)
-    v = model.init(jax.random.PRNGKey(0),
-                   jnp.zeros((1, image, image, 3)), train=False)
+    # key maps / fake state dicts / conversion templates only need SHAPES:
+    # eval_shape skips materializing 100M-param inits on CPU
+    v = jax.eval_shape(
+        lambda rng, x: model.init(rng, x, train=False),
+        jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)),
+    )
     return model, {"params": v["params"],
                    "batch_stats": v.get("batch_stats", {})}
 
