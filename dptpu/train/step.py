@@ -108,6 +108,13 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
 
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
+    # Gradient normalizer: the data-axis size, NOT mesh.size. Under
+    # shard_map's varying-axis semantics the param cotangents only vary
+    # over axes the batch varied over ({data}), so the automatic psum in
+    # the VJP spans exactly the data axis even when inner axes (e.g.
+    # {"data": N, "model": M}) are open — the model-axis duplicates are
+    # already invariant and are not summed. Locked by
+    # tests/test_train_step.py::test_axes_open_mesh_matches_single_device.
     axis_size = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
 
     def step(state, batch):

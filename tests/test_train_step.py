@@ -101,6 +101,29 @@ def test_ddp_step_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_axes_open_mesh_matches_single_device():
+    """Gradient scale on a factored {data, model} mesh must stay exact:
+    shard_map's varying-axis tracking psums param cotangents over the
+    data axis only (the model-axis duplicates are already invariant), so
+    the data-axis-size normalizer is correct with inner axes open — a
+    mesh.size normalizer would silently halve every update."""
+    mesh2 = make_mesh(mesh_shape={"data": 4, "model": 2})
+    batch = _batch(n=32)
+
+    s_ref = _make_state(bn_axis_name=None)
+    s_2ax = _make_state(bn_axis_name="data")
+    single = make_train_step()
+    two_axis = make_train_step(mesh=mesh2)
+
+    s_ref, m_ref = single(s_ref, batch)
+    s_2ax, m_2ax = two_axis(s_2ax, shard_host_batch(batch, mesh2))
+
+    assert float(m_2ax["loss"]) == pytest.approx(float(m_ref["loss"]), rel=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
+                    jax.tree_util.tree_leaves(jax.device_get(s_2ax.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_per_replica_bn_differs_from_sync_bn():
     # DDP default is NON-synced BN (SURVEY.md §7 hard part (b)); the two
     # modes must produce different batch_stats on heterogeneous shards.
