@@ -9,6 +9,7 @@ from dptpu.models import alexnet as _alexnet  # noqa: F401
 from dptpu.models import densenet as _densenet  # noqa: F401
 from dptpu.models import mnasnet as _mnasnet  # noqa: F401
 from dptpu.models import mobilenet as _mobilenet  # noqa: F401
+from dptpu.models import mobilenet_v3 as _mobilenet_v3  # noqa: F401
 from dptpu.models import resnet as _resnet  # noqa: F401
 from dptpu.models import shufflenet as _shufflenet  # noqa: F401
 from dptpu.models import squeezenet as _squeezenet  # noqa: F401

@@ -1,0 +1,181 @@
+"""MobileNetV3 (large / small), torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a mobilenet_v3_large``).
+Fresh Flax build of torchvision's ``mobilenetv3.py``:
+
+* stem 3x3/2 conv (16) BN hardswish;
+* inverted residuals with per-block kernel (3/5), expansion, optional
+  squeeze-excitation (reduce to ``_make_divisible(expanded / 4)``, ReLU ->
+  hardsigmoid gate), and ReLU or hardswish nonlinearity per the NAS
+  tables;
+* head 1x1 conv BN hardswish -> global average pool -> Linear(+hardswish,
+  Dropout 0.2) -> Linear classifier (the two-layer classifier is where
+  v3 differs from v2's single Linear).
+
+Channel rounding via ``_make_divisible(c, 8)``. Init matches torchvision:
+convs kaiming-normal fan-out, BN 1/0, Linears N(0, 0.01) with zero bias.
+Param counts locked in tests/test_models.py (large = 5,483,032 /
+small = 2,542,856).
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import kaiming_normal_fan_out
+from dptpu.models.mobilenet import _make_divisible
+from dptpu.models.registry import register_model
+
+# (kernel, expanded, out, use_se, activation, stride) per block;
+# activation: "RE" relu / "HS" hardswish — torchvision's bneck tables
+_LARGE = (
+    (3, 16, 16, False, "RE", 1),
+    (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1),
+    (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1),
+    (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2),
+    (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1),
+    (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2),
+    (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+)
+_SMALL = (
+    (3, 16, 16, True, "RE", 2),
+    (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1),
+    (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1),
+    (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1),
+    (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2),
+    (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+)
+_LAST = {"large": (960, 1280), "small": (576, 1024)}
+
+
+def _act(kind, x):
+    return nn.relu(x) if kind == "RE" else nn.hard_swish(x)
+
+
+class SqueezeExcite(nn.Module):
+    """torchvision SqueezeExcitation: avg pool -> 1x1 reduce -> ReLU ->
+    1x1 expand -> hardsigmoid gate (convs with bias)."""
+
+    reduced: int
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x):
+        s = x.mean(axis=(1, 2), keepdims=True)
+        s = self.conv(self.reduced, (1, 1), use_bias=True, name="fc1")(s)
+        s = nn.relu(s)
+        s = self.conv(x.shape[-1], (1, 1), use_bias=True, name="fc2")(s)
+        return x * nn.hard_sigmoid(s)
+
+
+class Bneck(nn.Module):
+    kernel: int
+    expanded: int
+    out_ch: int
+    use_se: bool
+    act: str
+    stride: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        y = x
+        if self.expanded != inp:
+            y = self.conv(self.expanded, (1, 1), name="expand")(y)
+            y = _act(self.act, self.norm(name="expand_bn")(y))
+        k, p = self.kernel, self.kernel // 2
+        y = self.conv(
+            self.expanded, (k, k), strides=(self.stride, self.stride),
+            padding=((p, p), (p, p)), feature_group_count=self.expanded,
+            name="dw",
+        )(y)
+        y = _act(self.act, self.norm(name="dw_bn")(y))
+        if self.use_se:
+            y = SqueezeExcite(
+                reduced=_make_divisible(self.expanded // 4),
+                conv=self.conv, name="se",
+            )(y)
+        y = self.conv(self.out_ch, (1, 1), name="project")(y)
+        y = self.norm(name="project_bn")(y)
+        if self.stride == 1 and inp == self.out_ch:
+            y = (x + y).astype(y.dtype)
+        return y
+
+
+class MobileNetV3(nn.Module):
+    size: str = "large"
+    num_classes: int = 1000
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_normal_fan_out,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.99,  # torchvision v3 BN momentum 0.01
+            epsilon=1e-3,  # and eps 0.001
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        table = _LARGE if self.size == "large" else _SMALL
+        last_conv, last_dense = _LAST[self.size]
+        x = conv(16, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="stem_conv")(x)
+        x = nn.hard_swish(norm(name="stem_bn")(x))
+        for i, (k, e, o, se, act, s) in enumerate(table):
+            x = Bneck(kernel=k, expanded=e, out_ch=_make_divisible(o),
+                      use_se=se, act=act, stride=s, conv=conv, norm=norm,
+                      name=f"block{i}")(x)
+        x = conv(last_conv, (1, 1), name="head_conv")(x)
+        x = nn.hard_swish(norm(name="head_bn")(x))
+        x = x.mean(axis=(1, 2))
+        dense = partial(
+            nn.Dense,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.01),
+            bias_init=nn.initializers.zeros,
+        )
+        x = dense(last_dense, name="pre_classifier")(x)
+        x = nn.hard_swish(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return dense(self.num_classes, name="classifier")(x)
+
+
+@register_model
+def mobilenet_v3_large(**kw):
+    return MobileNetV3(size="large", **kw)
+
+
+@register_model
+def mobilenet_v3_small(**kw):
+    return MobileNetV3(size="small", **kw)

@@ -76,6 +76,37 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
         if head.startswith("transition"):
             return f"features.{head}.{mod[1]}"
         return head  # classifier
+    if arch.startswith("mobilenet_v3"):
+        from dptpu.models.mobilenet_v3 import _LARGE, _SMALL
+
+        table = _LARGE if arch.endswith("large") else _SMALL
+        if head == "stem_conv":
+            return "features.0.0"
+        if head == "stem_bn":
+            return "features.0.1"
+        if head == "head_conv":
+            return f"features.{len(table) + 1}.0"
+        if head == "head_bn":
+            return f"features.{len(table) + 1}.1"
+        if head == "pre_classifier":
+            return "classifier.0"
+        if head == "classifier":
+            return "classifier.3"
+        # blocks: torch wraps each stage in a .block Sequential whose
+        # indices depend on whether expand and SE exist
+        k = int(head[5:])
+        kernel, expanded, out, use_se, act, stride = table[k]
+        inp = 16 if k == 0 else table[k - 1][2]
+        has_expand = expanded != inp
+        d = 1 if has_expand else 0  # depthwise position
+        se_pos, proj = d + 1, d + 1 + (1 if use_se else 0)
+        sub = mod[1]
+        m = {"expand": "block.0.0", "expand_bn": "block.0.1",
+             "dw": f"block.{d}.0", "dw_bn": f"block.{d}.1",
+             "project": f"block.{proj}.0", "project_bn": f"block.{proj}.1"}
+        if sub == "se":
+            return f"features.{k + 1}.block.{se_pos}.{mod[2]}"
+        return f"features.{k + 1}.{m[sub]}"
     if arch == "mobilenet_v2":
         # torchvision Sequential: features.0 stem ConvBNReLU, features.1..17
         # inverted residuals, features.18 head, classifier.1 Linear

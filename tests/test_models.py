@@ -46,6 +46,8 @@ TORCHVISION_PARAM_COUNTS = {
     "shufflenet_v2_x2_0": 7_393_996,
     "mnasnet0_75": 3_170_208,
     "mnasnet1_3": 6_282_256,
+    "mobilenet_v3_large": 5_483_032,
+    "mobilenet_v3_small": 2_542_856,
 }
 
 
@@ -80,7 +82,7 @@ def test_param_counts_match_torchvision(name):
 
 @pytest.mark.parametrize("name,image", [
     ("vgg11_bn", 224), ("mnasnet0_5", 64), ("resnext50_32x4d", 64),
-    ("wide_resnet50_2", 64), ("alexnet", 224),
+    ("wide_resnet50_2", 64), ("alexnet", 224), ("mobilenet_v3_small", 64),
 ])
 def test_family_concrete_init_and_forward(name, image):
     """One CONCRETE init+forward per family not covered elsewhere:

@@ -67,7 +67,8 @@ def _fake_torch_sd(arch, variables, rng):
                                   "squeezenet1_0", "vgg11_bn",
                                   "resnext50_32x4d", "wide_resnet50_2",
                                   "mobilenet_v2", "shufflenet_v2_x1_0",
-                                  "mnasnet1_0"])
+                                  "mnasnet1_0", "mobilenet_v3_large",
+                                  "mobilenet_v3_small"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
