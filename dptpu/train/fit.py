@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from dptpu.config import Config, DerivedConfig, derive
+from dptpu.config import Config, derive
 from dptpu.data import (
     DataLoader,
     DevicePrefetcher,

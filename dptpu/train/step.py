@@ -21,7 +21,6 @@ XLA way (fused into the first conv's input, zero extra HBM round-trips, and
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

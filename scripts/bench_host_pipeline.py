@@ -19,7 +19,6 @@ Usage: python scripts/bench_host_pipeline.py [--images 512] [--seconds 6]
 """
 
 import argparse
-import io
 import json
 import os
 import sys

@@ -137,7 +137,6 @@ def main():
     # ---- full-step A/B ----
     import dptpu.models.resnet as resnet_mod
     from dptpu.models import create_model
-    from dptpu.ops.loss import cross_entropy_loss
     from dptpu.ops.schedules import make_step_decay_schedule
     from dptpu.train import create_train_state, make_optimizer, make_train_step
     from flax.linen import compact

@@ -8,7 +8,6 @@ sync BN, schedule traced-vs-host parity, and checkpoint round-trip
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from flax import linen as nn
