@@ -1,0 +1,113 @@
+"""Device-time profiling: the trustworthy timing primitive on TPU.
+
+Wall-clock timing through a relayed/remote PJRT backend carries ~1.4 ms
+of per-dispatch overhead and drifts up to +-8% with chip contention
+(PERF.md round 3), so dptpu's performance methodology is built on XLA
+device traces instead: op durations come from the hardware's own
+profile, are contention-immune, and sum to the true step time.
+
+``profile_device_time(fn, *args)`` runs ``fn`` a few times under
+``jax.profiler.trace``, parses the perfetto export, and returns per-op
+device milliseconds. This is the tool behind PERF.md's attribution
+tables and the recommended first step for any "why is my step slow"
+investigation — before believing any wall-clock number.
+
+The reference's observability story is wall-clock meters plus explicit
+``torch.cuda.synchronize()`` before reads (imagenet_ddp_apex.py:406,
+SURVEY.md §5); meters remain the console surface here
+(dptpu/utils/meters.py), this module is the layer beneath them.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import tempfile
+from typing import Callable, Dict, Tuple
+
+
+def parse_perfetto_trace(trace: dict, iters: int = 1) -> Tuple[float, Dict[str, float]]:
+    """Sum device-side op durations from a loaded perfetto trace.
+
+    Returns ``(total_ms_per_iter, {op_name: ms_per_iter})``. Host-side
+    tracks are excluded; the per-core duplicate tracks TPU traces carry
+    are collapsed by taking the maximum-duration track per op name.
+    """
+    events = trace.get("traceEvents", [])
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e.get("args", {}).get("name", "")
+    dev_pids = {
+        p for p, n in pid_names.items()
+        if ("TPU" in n or "/device" in n or "Device" in n) and "Host" not in n
+    }
+    per_track: dict = collections.defaultdict(lambda: collections.Counter())
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        per_track[(e["pid"], e.get("tid"))][e.get("name", "")] += (
+            e.get("dur", 0) / 1000.0
+        )
+    by_op: collections.Counter = collections.Counter()
+    for track in per_track.values():
+        for name, ms in track.items():
+            by_op[name] = max(by_op[name], ms)
+    per_iter = {k: v / iters for k, v in by_op.items()}
+    # XLA module-level spans (named "jit_<fn>(...)") CONTAIN the op events:
+    # they are the authoritative totals (one per jitted module — summed, in
+    # case the profiled fn dispatches several), and they are filtered out
+    # of the per-op table so op shares don't double-count against it.
+    modules = {k: v for k, v in per_iter.items() if k.startswith("jit_")}
+    ops = {k: v for k, v in per_iter.items() if k not in modules}
+    if modules:
+        return sum(modules.values()), ops
+    return sum(ops.values()), ops
+
+
+def profile_device_time(fn: Callable, *args, iters: int = 6,
+                        fence: Callable = None):
+    """Trace ``iters`` calls of ``fn(*args)`` and return per-op device time.
+
+    ``fn`` should be a compiled callable whose outputs carry at least one
+    array; ``fence`` (default: fetch the first output leaf) forces
+    completion — on relayed backends only a device->host value read is a
+    trustworthy fence (PERF.md).
+    """
+    import jax
+
+    def default_fence(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(leaf.ravel()[0])
+
+    fence = fence or default_fence
+    out = fn(*args)
+    fence(out)  # warm / compile outside the trace
+    tmp = tempfile.mkdtemp(prefix="dptpu_prof_")
+    try:
+        with jax.profiler.trace(tmp):
+            for _ in range(iters):
+                out = fn(*args)
+            fence(out)
+        paths = sorted(
+            glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"),
+                      recursive=True)
+        )
+        if not paths:
+            raise RuntimeError(f"no trace written under {tmp}")
+        # one file per host on multi-process runs: merge event streams so
+        # no worker's device time is silently dropped
+        merged = {"traceEvents": []}
+        for path in paths:
+            with gzip.open(path, "rt") as f:
+                merged["traceEvents"].extend(
+                    json.load(f).get("traceEvents", [])
+                )
+        return parse_perfetto_trace(merged, iters=iters)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -47,3 +47,37 @@ def test_run_dir_naming_comment():
         import shutil
 
         shutil.rmtree("runs", ignore_errors=True)
+
+
+def test_parse_perfetto_trace_sums_device_ops():
+    """Device-time parser: host tracks excluded, per-core duplicate tracks
+    collapsed by max, durations normalized per iteration."""
+    from dptpu.utils.profiling import parse_perfetto_trace
+
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "Host threads"}},
+        # two duplicate device tracks (tids) reporting the same ops
+        {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.1", "dur": 4000},
+        {"ph": "X", "pid": 1, "tid": 11, "name": "fusion.1", "dur": 4000},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "copy.2", "dur": 1000},
+        # host event must not count
+        {"ph": "X", "pid": 2, "tid": 20, "name": "dispatch", "dur": 9999},
+    ]}
+    total, per_op = parse_perfetto_trace(trace, iters=2)
+    assert per_op == {"fusion.1": 2.0, "copy.2": 0.5}  # us->ms, /iters
+    assert total == 2.5
+    # with module-level jit_ spans present, their SUM is the total and
+    # they are filtered from the per-op table (children would otherwise
+    # double-count against the total)
+    trace["traceEvents"].append(
+        {"ph": "X", "pid": 1, "tid": 10, "name": "jit_step(123)", "dur": 5200}
+    )
+    trace["traceEvents"].append(
+        {"ph": "X", "pid": 1, "tid": 10, "name": "jit_aux(9)", "dur": 800}
+    )
+    total, per_op = parse_perfetto_trace(trace, iters=2)
+    assert total == 3.0  # 2.6 + 0.4
+    assert not any(k.startswith("jit_") for k in per_op)
