@@ -1,3 +1,5 @@
 from dptpu.utils.meters import AverageMeter, ProgressMeter, Summary
+from dptpu.utils.profiling import parse_perfetto_trace, profile_device_time
 
-__all__ = ["AverageMeter", "ProgressMeter", "Summary"]
+__all__ = ["AverageMeter", "ProgressMeter", "Summary",
+           "parse_perfetto_trace", "profile_device_time"]

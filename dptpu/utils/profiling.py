@@ -59,8 +59,11 @@ def parse_perfetto_trace(trace: dict, iters: int = 1) -> Tuple[float, Dict[str, 
     per_iter = {k: v / iters for k, v in by_op.items()}
     # XLA module-level spans (named "jit_<fn>(...)") CONTAIN the op events:
     # they are the authoritative totals (one per jitted module — summed, in
-    # case the profiled fn dispatches several), and they are filtered out
-    # of the per-op table so op shares don't double-count against it.
+    # case the profiled fn dispatches several distinct modules), and they
+    # are filtered out of the per-op table so op shares don't double-count
+    # against it. NOTE the max-collapse above makes multi-replica semantics
+    # "the slowest replica's time" per op: SPMD workers run the same
+    # program, so the max is the critical-path one.
     modules = {k: v for k, v in per_iter.items() if k.startswith("jit_")}
     ops = {k: v for k, v in per_iter.items() if k not in modules}
     if modules:
@@ -98,14 +101,18 @@ def profile_device_time(fn: Callable, *args, iters: int = 6,
         )
         if not paths:
             raise RuntimeError(f"no trace written under {tmp}")
-        # one file per host on multi-process runs: merge event streams so
-        # no worker's device time is silently dropped
+        # one file per host on multi-process runs. Perfetto pids are only
+        # unique within a file, so namespace them per source file before
+        # merging — otherwise host tracks from one file can masquerade as
+        # device tracks of another. The parser's max-collapse then yields
+        # the slowest replica's per-op time (the SPMD critical path).
         merged = {"traceEvents": []}
-        for path in paths:
+        for i, path in enumerate(paths):
             with gzip.open(path, "rt") as f:
-                merged["traceEvents"].extend(
-                    json.load(f).get("traceEvents", [])
-                )
+                for e in json.load(f).get("traceEvents", []):
+                    if "pid" in e:
+                        e = dict(e, pid=(i, e["pid"]))
+                    merged["traceEvents"].append(e)
         return parse_perfetto_trace(merged, iters=iters)
     finally:
         import shutil

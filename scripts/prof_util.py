@@ -1,69 +1,44 @@
-"""Shared profiling helper: capture a device trace of a step function and
-sum per-op device time from the perfetto export (the PERF.md methodology)."""
+"""Shim for the round-3 experiment scripts: the trace-parsing logic now
+lives in dptpu.utils.profiling (promoted to the framework); this keeps
+the historical exp_*.py scripts runnable."""
 
 import collections
-import glob
-import gzip
-import json
-import os
-import tempfile
+
+from dptpu.utils.profiling import profile_device_time
 
 
 def profile_step(fn, state, batch, iters=8):
-    """Run fn(state, batch) iters times under the profiler; return
-    (total_ms_per_step, {op_bucket: ms_per_step})."""
-    import jax
+    """(total_ms, {bucket: ms}, {}) for a (state, batch) step function."""
+    holder = {"st": state}
 
-    st, m = fn(state, batch)  # warm/compile outside the trace
-    st, m = fn(st, batch)
-    float(m["loss"])
-    tmp = tempfile.mkdtemp(prefix="jaxprof_")
-    with jax.profiler.trace(tmp):
-        for _ in range(iters):
-            st, m = fn(st, batch)
-        float(m["loss"])
-    paths = glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"), recursive=True)
-    if not paths:
-        raise RuntimeError(f"no trace found under {tmp}")
-    with gzip.open(paths[0], "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
-    # find device-side process ids (TPU/device tracks, not python host)
-    pid_names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_names[e["pid"]] = e["args"].get("name", "")
-    dev_pids = {p for p, n in pid_names.items()
-                if ("TPU" in n or "/device" in n or "Device" in n) and "Host" not in n}
-    by_op = collections.Counter()
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
-            continue
-        name = e.get("name", "")
-        dur = e.get("dur", 0) / 1000.0  # us -> ms
-        total += dur
-        by_op[bucket(name)] += dur
-    per_step = {k: v / iters for k, v in by_op.items()}
-    return total / iters, per_step, pid_names
+    def call():
+        holder["st"], m = fn(holder["st"], batch)
+        return m
+
+    def fence(out):
+        float(out["loss"])
+
+    total, per_op = profile_device_time(call, iters=iters, fence=fence)
+    buckets = collections.Counter()
+    for name, ms in per_op.items():
+        buckets[bucket(name)] += ms
+    return total, dict(buckets), {}
 
 
 def bucket(name):
     n = name.lower()
-    if "convolution" in n or n.startswith("%conv") or "conv" in n.split(".")[0]:
+    if "convolution" in n or n.split(".")[0] in ("conv", "convs"):
         return "conv-fusion"
     if "select-and-scatter" in n or "select_and_scatter" in n:
         return "select-and-scatter"
     if "copy" in n:
         return "copy"
-    if "reduce-window" in n or "reduce_window" in n:
+    if "reduce-window" in n:
         return "reduce-window"
-    if "all-reduce" in n or "all_reduce" in n:
+    if "all-reduce" in n:
         return "all-reduce"
     if "fusion" in n:
         return "other-fusion"
-    if "transpose" in n:
-        return "transpose"
     if "dynamic" in n or "slice" in n:
         return "slice"
     return "misc:" + name.split(".")[0][:28]
