@@ -1,0 +1,206 @@
+"""GoogLeNet (Inception v1), torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a googlenet``). Fresh
+Flax build of torchvision's ``googlenet.py``:
+
+* BasicConv2d everywhere: bias-free conv -> BN(eps 1e-3) -> ReLU;
+* stem 7x7/2 (64) -> ceil-pool -> 1x1 (64) -> 3x3 (192) -> ceil-pool;
+* nine Inception modules (3a..5b) with the classic four branches — note
+  torchvision's historical quirk, preserved here: the "5x5" branch
+  actually uses a 3x3 kernel;
+* optional auxiliary heads (on 4a and 4d): avg-pool to 4x4 -> 1x1 (128)
+  -> fc 1024 -> dropout 0.7 -> fc. Default ``aux_logits=False``
+  (6,624,904 params, torchvision's documented count); ``aux_logits=True``
+  adds them to the tree (13,004,888 = 6,624,904 + 2 x 3,189,992) as an
+  **inference-frozen eval/conversion mode**: their BN always uses running
+  stats (so nothing keeps the branch alive and XLA dead-code-eliminates
+  the unused forward) and no gradient reaches them. Note that optimizer
+  weight decay still nominally applies to any parameter, so TRAIN with
+  the default and use ``aux_logits=True`` to round-trip aux-bearing
+  torchvision checkpoints or evaluate converted weights. Either way this
+  is deliberately MORE usable than the reference, whose scripts crash on
+  googlenet's train-mode namedtuple output (``criterion(GoogLeNetOutputs,
+  target)``); dptpu trains the main head exactly as the reference's loss
+  would if it could. (Standard checkpoints convert fine with the default
+  too: extra torch keys are ignored.)
+
+Init: torchvision uses truncated-normal(std 0.01) for conv/linear weights
+(absolute clip +-2.0, which at std 0.01 is effectively untruncated; flax's
+truncated_normal clips at +-2 std — indistinguishable in practice). BN
+scale 1 / bias 0; Linear biases keep torch's untouched default
+U(+-1/sqrt(fan_in)) — torchvision's init loop only reassigns weights.
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    adaptive_avg_pool,
+    ceil_max_pool,
+    torch_default_bias_init,
+)
+from dptpu.models.registry import register_model
+
+_trunc001 = nn.initializers.truncated_normal(stddev=0.01)
+
+
+class BasicConv2d(nn.Module):
+    features: int
+    kernel: tuple
+    conv: Any
+    norm: Any
+    stride: int = 1
+    padding: tuple = ((0, 0), (0, 0))
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(
+            self.features, self.kernel, strides=(self.stride, self.stride),
+            padding=self.padding, name="conv",
+        )(x)
+        return nn.relu(self.norm(name="bn")(x))
+
+
+class InceptionModule(nn.Module):
+    ch1: int
+    ch3red: int
+    ch3: int
+    ch5red: int
+    ch5: int
+    pool_proj: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        bc = partial(BasicConv2d, conv=self.conv, norm=self.norm)
+        b1 = bc(self.ch1, (1, 1), name="branch1")(x)
+        b2 = bc(self.ch3red, (1, 1), name="branch2_0")(x)
+        b2 = bc(self.ch3, (3, 3), padding=((1, 1), (1, 1)),
+                name="branch2_1")(b2)
+        b3 = bc(self.ch5red, (1, 1), name="branch3_0")(x)
+        # torchvision quirk: the "5x5" branch is a 3x3 conv (kept for
+        # checkpoint compatibility with the original implementation bug)
+        b3 = bc(self.ch5, (3, 3), padding=((1, 1), (1, 1)),
+                name="branch3_1")(b3)
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1),
+                         padding=((1, 1), (1, 1)))
+        b4 = bc(self.pool_proj, (1, 1), name="branch4_1")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    """Inference-frozen aux head: BN reads running stats (never updates),
+    dropout deterministic — keeps the unused branch fully dead code under
+    train so XLA prunes it, and converted stats stay put."""
+
+    num_classes: int
+    conv: Any
+    frozen_norm: Any
+    dense: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = adaptive_avg_pool(x, 4)
+        x = BasicConv2d(128, (1, 1), conv=self.conv, norm=self.frozen_norm,
+                        name="conv")(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(self.dense(1024, torch_default_bias_init(2048),
+                               name="fc1")(x))
+        return self.dense(self.num_classes, torch_default_bias_init(1024),
+                          name="fc2")(x)
+
+
+# (ch1, ch3red, ch3, ch5red, ch5, pool_proj) per module; "P" = ceil pool
+_MODULES = [
+    ("inception3a", (64, 96, 128, 16, 32, 32)),
+    ("inception3b", (128, 128, 192, 32, 96, 64)), "P",
+    ("inception4a", (192, 96, 208, 16, 48, 64)),
+    ("inception4b", (160, 112, 224, 24, 64, 64)),
+    ("inception4c", (128, 128, 256, 24, 64, 64)),
+    ("inception4d", (112, 144, 288, 32, 64, 64)),
+    ("inception4e", (256, 160, 320, 32, 128, 128)), "P2",
+    ("inception5a", (256, 160, 320, 32, 128, 128)),
+    ("inception5b", (384, 192, 384, 48, 128, 128)),
+]
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=_trunc001,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-3,  # torchvision BasicConv2d eps=0.001
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        def dense(features, bias_init, name):
+            # torchvision's init loop only touches weights: Linear biases
+            # keep torch's default U(+-1/sqrt(fan_in))
+            return nn.Dense(
+                features,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=_trunc001,
+                bias_init=bias_init,
+                name=name,
+            )
+
+        frozen_norm = partial(norm, use_running_average=True)
+        bc = partial(BasicConv2d, conv=conv, norm=norm)
+        x = bc(64, (7, 7), stride=2, padding=((3, 3), (3, 3)), name="conv1")(x)
+        x = ceil_max_pool(x)
+        x = bc(64, (1, 1), name="conv2")(x)
+        x = bc(192, (3, 3), padding=((1, 1), (1, 1)), name="conv3")(x)
+        x = ceil_max_pool(x)
+        aux1 = aux2 = None
+        for spec in _MODULES:
+            if spec == "P":
+                x = ceil_max_pool(x)
+                continue
+            if spec == "P2":
+                x = ceil_max_pool(x, window=2, stride=2)
+                continue
+            name, chans = spec
+            x = InceptionModule(*chans, conv=conv, norm=norm, name=name)(x)
+            # aux heads hang off 4a and 4d (torchvision placement); their
+            # outputs are traced but unused — XLA prunes the dead compute,
+            # while the params stay in the tree for --pretrained parity
+            if self.aux_logits and name == "inception4a":
+                aux1 = InceptionAux(self.num_classes, conv=conv,
+                                    frozen_norm=frozen_norm, dense=dense,
+                                    name="aux1")(x)
+            elif self.aux_logits and name == "inception4d":
+                aux2 = InceptionAux(self.num_classes, conv=conv,
+                                    frozen_norm=frozen_norm, dense=dense,
+                                    name="aux2")(x)
+        del aux1, aux2  # main-head training; see module docstring
+        x = x.mean(axis=(1, 2))  # adaptive avg pool (1,1) + flatten
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return dense(self.num_classes, torch_default_bias_init(1024),
+                     name="fc")(x)
+
+
+@register_model
+def googlenet(**kw):
+    return GoogLeNet(**kw)

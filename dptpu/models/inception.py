@@ -1,0 +1,260 @@
+"""Inception v3, torchvision-architecture-exact, NHWC (299x299 native).
+
+Registry-discoverable (``-a inception_v3``). The reference's Apex script
+rejects this arch outright (imagenet_ddp_apex.py:209-210) and the ddp/nd
+scripts crash on its train-mode namedtuple output, so dptpu goes one
+better: the main head trains normally; the auxiliary head is optional
+(``aux_logits=True`` adds it to the parameter tree, traced but unused —
+XLA prunes the dead compute; default False). Param counts:
+23,834,568 without aux, 27,161,264 with — the latter is torchvision's
+documented number (its default constructor carries the aux head).
+
+Structure per torchvision ``inception.py``: BasicConv2d (bias-free conv
+-> BN eps 1e-3 -> ReLU) stem 3x3/2 32 -> 3x3 32 -> 3x3p1 64 -> pool ->
+1x1 80 -> 3x3 192 -> pool; InceptionA x3 (5x5 + double-3x3 + pool
+branches), InceptionB (stride-2 reduction), InceptionC x4 (factorized
+1x7/7x1 chains at c7 = 128/160/160/192), InceptionD (reduction),
+InceptionE x2 (split 1x3/3x1 pairs); dropout 0.5; fc. ``transform_input``
+reproduces torchvision's pretrained input rescaling. Init: truncated
+normal, std 0.1 for convs except the aux head's documented 0.01/0.001.
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.googlenet import BasicConv2d
+from dptpu.models.layers import max_pool_same_as_torch, torch_default_bias_init
+from dptpu.models.registry import register_model
+
+
+def _trunc(std):
+    return nn.initializers.truncated_normal(stddev=std)
+
+
+def _avg_pool_3x3_pad1(x):
+    # torch AvgPool2d(3, stride=1, padding=1) with count_include_pad=True
+    s = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)),
+                    count_include_pad=True)
+    return s
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    bc: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.bc(64, (1, 1), name="branch1x1")(x)
+        b5 = self.bc(48, (1, 1), name="branch5x5_1")(x)
+        b5 = self.bc(64, (5, 5), padding=((2, 2), (2, 2)),
+                     name="branch5x5_2")(b5)
+        b3 = self.bc(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = self.bc(96, (3, 3), padding=((1, 1), (1, 1)),
+                     name="branch3x3dbl_2")(b3)
+        b3 = self.bc(96, (3, 3), padding=((1, 1), (1, 1)),
+                     name="branch3x3dbl_3")(b3)
+        bp = _avg_pool_3x3_pad1(x)
+        bp = self.bc(self.pool_features, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    bc: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.bc(384, (3, 3), stride=2, name="branch3x3")(x)
+        bd = self.bc(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = self.bc(96, (3, 3), padding=((1, 1), (1, 1)),
+                     name="branch3x3dbl_2")(bd)
+        bd = self.bc(96, (3, 3), stride=2, name="branch3x3dbl_3")(bd)
+        bp = max_pool_same_as_torch(x, 3, 2, 0)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    c7: int
+    bc: Any
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.c7
+        b1 = self.bc(192, (1, 1), name="branch1x1")(x)
+        b7 = self.bc(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = self.bc(c7, (1, 7), padding=((0, 0), (3, 3)),
+                     name="branch7x7_2")(b7)
+        b7 = self.bc(192, (7, 1), padding=((3, 3), (0, 0)),
+                     name="branch7x7_3")(b7)
+        bd = self.bc(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = self.bc(c7, (7, 1), padding=((3, 3), (0, 0)),
+                     name="branch7x7dbl_2")(bd)
+        bd = self.bc(c7, (1, 7), padding=((0, 0), (3, 3)),
+                     name="branch7x7dbl_3")(bd)
+        bd = self.bc(c7, (7, 1), padding=((3, 3), (0, 0)),
+                     name="branch7x7dbl_4")(bd)
+        bd = self.bc(192, (1, 7), padding=((0, 0), (3, 3)),
+                     name="branch7x7dbl_5")(bd)
+        bp = _avg_pool_3x3_pad1(x)
+        bp = self.bc(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    bc: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.bc(192, (1, 1), name="branch3x3_1")(x)
+        b3 = self.bc(320, (3, 3), stride=2, name="branch3x3_2")(b3)
+        b7 = self.bc(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = self.bc(192, (1, 7), padding=((0, 0), (3, 3)),
+                     name="branch7x7x3_2")(b7)
+        b7 = self.bc(192, (7, 1), padding=((3, 3), (0, 0)),
+                     name="branch7x7x3_3")(b7)
+        b7 = self.bc(192, (3, 3), stride=2, name="branch7x7x3_4")(b7)
+        bp = max_pool_same_as_torch(x, 3, 2, 0)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    bc: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.bc(320, (1, 1), name="branch1x1")(x)
+        b3 = self.bc(384, (1, 1), name="branch3x3_1")(x)
+        b3 = jnp.concatenate([
+            self.bc(384, (1, 3), padding=((0, 0), (1, 1)),
+                    name="branch3x3_2a")(b3),
+            self.bc(384, (3, 1), padding=((1, 1), (0, 0)),
+                    name="branch3x3_2b")(b3),
+        ], axis=-1)
+        bd = self.bc(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = self.bc(384, (3, 3), padding=((1, 1), (1, 1)),
+                     name="branch3x3dbl_2")(bd)
+        bd = jnp.concatenate([
+            self.bc(384, (1, 3), padding=((0, 0), (1, 1)),
+                    name="branch3x3dbl_3a")(bd),
+            self.bc(384, (3, 1), padding=((1, 1), (0, 0)),
+                    name="branch3x3dbl_3b")(bd),
+        ], axis=-1)
+        bp = _avg_pool_3x3_pad1(x)
+        bp = self.bc(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3Aux(nn.Module):
+    """Inference-frozen aux head (see googlenet.InceptionAux): BN reads
+    running stats so the unused branch stays dead code under train."""
+
+    num_classes: int
+    conv01: Any
+    frozen_norm: Any
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        bc = partial(BasicConv2d, conv=self.conv01, norm=self.frozen_norm)
+        a = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        a = bc(128, (1, 1), name="conv0")(a)
+        a = bc(768, (5, 5), name="conv1")(a)
+        a = a.mean(axis=(1, 2))
+        return nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=_trunc(0.001),
+            bias_init=torch_default_bias_init(768),
+            name="fc",
+        )(a)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    aux_logits: bool = False
+    transform_input: bool = False
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def conv_with(std):
+            return partial(
+                nn.Conv,
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=_trunc(std),
+            )
+
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-3,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        bc = partial(BasicConv2d, conv=conv_with(0.1), norm=norm)
+        if self.transform_input:
+            # torchvision's pretrained input remapping (inception.py)
+            ch = [
+                x[..., i:i + 1] * s + b
+                for i, (s, b) in enumerate([
+                    (0.229 / 0.5, (0.485 - 0.5) / 0.5),
+                    (0.224 / 0.5, (0.456 - 0.5) / 0.5),
+                    (0.225 / 0.5, (0.406 - 0.5) / 0.5),
+                ])
+            ]
+            x = jnp.concatenate(ch, axis=-1)
+        x = bc(32, (3, 3), stride=2, name="Conv2d_1a_3x3")(x)
+        x = bc(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = bc(64, (3, 3), padding=((1, 1), (1, 1)), name="Conv2d_2b_3x3")(x)
+        x = max_pool_same_as_torch(x, 3, 2, 0)
+        x = bc(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = bc(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = max_pool_same_as_torch(x, 3, 2, 0)
+        x = InceptionA(pool_features=32, bc=bc, name="Mixed_5b")(x)
+        x = InceptionA(pool_features=64, bc=bc, name="Mixed_5c")(x)
+        x = InceptionA(pool_features=64, bc=bc, name="Mixed_5d")(x)
+        x = InceptionB(bc=bc, name="Mixed_6a")(x)
+        x = InceptionC(c7=128, bc=bc, name="Mixed_6b")(x)
+        x = InceptionC(c7=160, bc=bc, name="Mixed_6c")(x)
+        x = InceptionC(c7=160, bc=bc, name="Mixed_6d")(x)
+        x = InceptionC(c7=192, bc=bc, name="Mixed_6e")(x)
+        if self.aux_logits:
+            # inference-frozen, traced but unused (XLA prunes the dead
+            # branch); params stay in the tree for --pretrained round trips
+            _ = InceptionV3Aux(
+                self.num_classes,
+                conv01=conv_with(0.01),
+                frozen_norm=partial(norm, use_running_average=True),
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="AuxLogits",
+            )(x)
+        x = InceptionD(bc=bc, name="Mixed_7a")(x)
+        x = InceptionE(bc=bc, name="Mixed_7b")(x)
+        x = InceptionE(bc=bc, name="Mixed_7c")(x)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=_trunc(0.1),
+            bias_init=torch_default_bias_init(2048),  # torch default kept
+            name="fc",
+        )(x)
+
+
+@register_model
+def inception_v3(**kw):
+    return InceptionV3(**kw)

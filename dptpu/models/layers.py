@@ -120,6 +120,21 @@ class FusedBNReLUPool(nn.Module):
         )
 
 
+def ceil_max_pool(x, window=3, stride=2):
+    """``nn.MaxPool2d(window, stride, ceil_mode=True)`` on NHWC input —
+    the ceil-rounded output grid, realized by -inf bottom/right padding
+    exactly when needed (used by SqueezeNet and GoogLeNet)."""
+    _, h, w, _ = x.shape
+    oh = -(-(h - window) // stride) + 1
+    ow = -(-(w - window) // stride) + 1
+    pad_h = max(0, (oh - 1) * stride + window - h)
+    pad_w = max(0, (ow - 1) * stride + window - w)
+    return nn.max_pool(
+        x, (window, window), strides=(stride, stride),
+        padding=((0, pad_h), (0, pad_w)),
+    )
+
+
 def adaptive_avg_pool(x, output_size):
     """``nn.AdaptiveAvgPool2d(output_size)`` on NHWC input, torch semantics.
 

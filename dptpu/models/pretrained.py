@@ -29,6 +29,12 @@ batch_stats ``mean`` / ``var``          ``running_mean`` / ``running_var``
 
 ``num_batches_tracked`` buffers are dropped (dptpu's schedules are pure
 functions of the global step).
+
+One transpose subtlety: a Linear that consumes a *flattened conv map*
+(alexnet/vgg first classifier, googlenet aux fc1) sees CHW-ordered inputs
+in torch but HWC-ordered inputs here, so its kernel needs a spatial
+permutation, not just the OI->IO transpose — handled by the
+``dense_chw`` kinds below (shapes alone would silently match).
 """
 
 from __future__ import annotations
@@ -132,6 +138,15 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
                        ("conv", 1): "conv.1", ("bn", 1): "conv.2"}[(kind, i)]
             return f"features.{k + 1}.{sub}"
         return "classifier.1"
+    if arch == "googlenet":
+        # plain dotted join, with torchvision's branchN Sequential indices
+        # (branch2_1 -> branch2.1); aux1/aux2 and conv1..3 join directly
+        out = ".".join(mod)
+        for b in ("branch2", "branch3", "branch4"):
+            out = out.replace(f"{b}_", f"{b}.")
+        return out
+    if arch == "inception_v3":
+        return ".".join(mod)  # names mirror torchvision module paths
     if arch.startswith("shufflenet_v2"):
         # torch: conv1/conv5 are Sequential(conv, bn); units are
         # stage{s}.{i} with branch1 = (dw, bn, pw, bn) and branch2 =
@@ -196,7 +211,12 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
             tmod = _torch_module(arch, names[:-1])
             tleaf = _LEAF_TO_TORCH[names[-1]]
             if names[-1] == "kernel":
-                kind = "conv" if leaf.ndim == 4 else "dense"
+                if leaf.ndim == 4:
+                    kind = "conv"
+                else:
+                    chw = _DENSE_CHW.get((arch.split("_bn")[0].rstrip("0123456789"), names[:-1])) \
+                        or _DENSE_CHW.get((arch, names[:-1]))
+                    kind = ("dense_chw", chw) if chw else "dense"
             else:
                 kind = "direct"
             key = f"{tmod}.{tleaf}"
@@ -205,21 +225,47 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
     return out
 
 
-def _from_torch(arr: np.ndarray, kind: str) -> np.ndarray:
+# Linears that consume a FLATTENED conv map: (family-or-arch, module path)
+# -> the (C, H, W) the torch weight's input axis factorizes as. Flax
+# flattens those maps HWC, torch flattens CHW, so these kernels need a
+# spatial permutation on top of the OI->IO transpose.
+_DENSE_CHW = {
+    ("alexnet", ("classifier_1",)): (256, 6, 6),
+    ("vgg", ("classifier_0",)): (512, 7, 7),
+    ("googlenet", ("aux1", "fc1")): (128, 4, 4),
+    ("googlenet", ("aux2", "fc1")): (128, 4, 4),
+}
+
+
+def _from_torch(arr: np.ndarray, kind) -> np.ndarray:
     arr = np.asarray(arr)
     if kind == "conv":
         return np.transpose(arr, (2, 3, 1, 0))  # OIHW -> HWIO
     if kind == "dense":
         return np.transpose(arr, (1, 0))  # OI -> IO
+    if isinstance(kind, tuple) and kind[0] == "dense_chw":
+        c, h, w = kind[1]
+        o = arr.shape[0]
+        # torch (O, C*H*W) -> flax (H*W*C, O): reorder the input axis to
+        # the NHWC flatten order before transposing
+        return np.transpose(
+            arr.reshape(o, c, h, w), (2, 3, 1, 0)
+        ).reshape(h * w * c, o)
     return arr
 
 
-def _to_torch(arr: np.ndarray, kind: str) -> np.ndarray:
+def _to_torch(arr: np.ndarray, kind) -> np.ndarray:
     arr = np.asarray(arr)
     if kind == "conv":
         return np.transpose(arr, (3, 2, 0, 1))  # HWIO -> OIHW
     if kind == "dense":
         return np.transpose(arr, (1, 0))
+    if isinstance(kind, tuple) and kind[0] == "dense_chw":
+        c, h, w = kind[1]
+        o = arr.shape[-1]
+        return np.transpose(
+            arr.reshape(h, w, c, o), (3, 2, 0, 1)
+        ).reshape(o, c * h * w)
     return arr
 
 

@@ -28,25 +28,13 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from dptpu.models.layers import ceil_max_pool
 from dptpu.models.registry import register_model
 
 # kaiming_uniform_(a=0, fan_in, leaky_relu): bound sqrt(6/fan_in)
 kaiming_uniform_fan_in = nn.initializers.variance_scaling(
     2.0, "fan_in", "uniform"
 )
-
-
-def _ceil_max_pool(x, window=3, stride=2):
-    """``nn.MaxPool2d(window, stride, ceil_mode=True)`` on NHWC input."""
-    _, h, w, _ = x.shape
-    oh = -(-(h - window) // stride) + 1
-    ow = -(-(w - window) // stride) + 1
-    pad_h = max(0, (oh - 1) * stride + window - h)
-    pad_w = max(0, (ow - 1) * stride + window - w)
-    return nn.max_pool(
-        x, (window, window), strides=(stride, stride),
-        padding=((0, pad_h), (0, pad_w)),
-    )
 
 
 class Fire(nn.Module):
@@ -109,7 +97,7 @@ class SqueezeNet(nn.Module):
         fire_idx = 1
         for spec in _PLANS[self.version]:
             if spec == "P":
-                x = _ceil_max_pool(x)
+                x = ceil_max_pool(x)
             elif spec[0] == "conv":
                 _, feats, k, s = spec
                 x = nn.relu(

@@ -89,6 +89,13 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         else partial(shard_host_batch, mesh=mesh)
     )
 
+    if cfg.variant == "apex" and cfg.arch == "inception_v3":
+        # reference parity: the Apex script rejects inception_v3 by name
+        # (imagenet_ddp_apex.py:209-210); ddp/nd train its main head here
+        raise RuntimeError(
+            "Currently, inception_v3 is not supported by this example."
+        )
+
     train_ds, val_ds, num_classes = _build_datasets(cfg, image_size)
 
     # per-host loaders over disjoint shards (DistributedSampler contract);

@@ -91,3 +91,14 @@ def test_dropout_arch_trains_on_mesh(tmp_path, monkeypatch):
     result = fit(cfg, image_size=64, verbose=False)
     assert result["epochs_run"] == 1
     assert np.isfinite(result["history"][0]["train_loss"])
+
+
+def test_apex_rejects_inception_v3_like_reference():
+    """Reference parity: the Apex script refuses inception_v3 by name
+    (imagenet_ddp_apex.py:209-210) — same message, before any data work."""
+    cfg = parse_config(
+        ["synthetic:16", "-a", "inception_v3", "-b", "8", "--epochs", "1"],
+        variant="apex",
+    ).replace(dist_url="env://")
+    with pytest.raises(RuntimeError, match="inception_v3 is not supported"):
+        fit(cfg, image_size=64, verbose=False)

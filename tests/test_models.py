@@ -48,6 +48,7 @@ TORCHVISION_PARAM_COUNTS = {
     "mnasnet1_3": 6_282_256,
     "mobilenet_v3_large": 5_483_032,
     "mobilenet_v3_small": 2_542_856,
+    "googlenet": 6_624_904,
 }
 
 
@@ -134,6 +135,35 @@ def test_densenet_forward_and_bn_state():
     )
     assert out.shape == (2, 5)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_googlenet_inception_aux_param_counts():
+    """torchvision's documented inception_v3 count (27,161,264) includes
+    the aux head (its default constructor carries it); googlenet's
+    documented 6,624,904 excludes aux. Lock both aux trees."""
+    import jax as _jax
+
+    def count(name, **kw):
+        m = create_model(name, **kw)
+        image = 299 if name == "inception_v3" else 64
+        shapes = _jax.eval_shape(
+            lambda r, x: m.init(r, x), jax.random.PRNGKey(0),
+            jnp.zeros((1, image, image, 3)),
+        )
+        return _count(shapes["params"])
+
+    assert count("inception_v3", aux_logits=True) == 27_161_264
+    assert count("inception_v3") == 23_834_568  # minus the aux head
+    assert count("googlenet", aux_logits=True) == 13_004_888
+
+
+def test_googlenet_inception_forward():
+    for name, image in (("googlenet", 64), ("inception_v3", 299)):
+        m = create_model(name, num_classes=4)
+        v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+        out = m.apply(v, jnp.zeros((2, image, image, 3)), train=False)
+        assert out.shape == (2, 4)
+        assert np.isfinite(np.asarray(out)).all()
 
 
 def test_registry_surface():

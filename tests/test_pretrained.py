@@ -68,7 +68,7 @@ def _fake_torch_sd(arch, variables, rng):
                                   "resnext50_32x4d", "wide_resnet50_2",
                                   "mobilenet_v2", "shufflenet_v2_x1_0",
                                   "mnasnet1_0", "mobilenet_v3_large",
-                                  "mobilenet_v3_small"])
+                                  "mobilenet_v3_small", "googlenet"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
@@ -189,3 +189,58 @@ def test_converter_cli_npz_input(tmp_path, monkeypatch):
                  "-o", str(out_dir)]) == 0
     loaded = load_npz(str(out_dir / "resnet18.npz"))
     assert "conv1" in loaded["params"]
+
+
+def test_aux_head_key_maps():
+    """aux_logits=True trees map every aux key to torchvision's names."""
+    for arch, kw, need in [
+        ("googlenet", {"aux_logits": True},
+         ("aux1.conv.conv.weight", "aux1.conv.bn.running_var",
+          "aux1.fc1.weight", "aux2.fc2.bias")),
+        ("inception_v3", {"aux_logits": True},
+         ("AuxLogits.conv0.conv.weight", "AuxLogits.conv1.bn.running_mean",
+          "AuxLogits.fc.weight", "Mixed_7c.branch_pool.conv.weight")),
+    ]:
+        model = create_model(arch, num_classes=10, **kw)
+        image = 299 if arch == "inception_v3" else 64
+        v = jax.eval_shape(
+            lambda rng, x: model.init(rng, x, train=False),
+            jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)),
+        )
+        variables = {"params": v["params"],
+                     "batch_stats": v.get("batch_stats", {})}
+        kmap = torch_key_map(arch, variables)
+        n_leaves = sum(len(jax.tree_util.tree_leaves(variables[c]))
+                       for c in ("params", "batch_stats"))
+        assert len(kmap) == n_leaves
+        for k in need:
+            assert k in kmap, k
+
+
+def test_dense_after_flatten_reorders_chw():
+    """Linears that consume flattened conv maps: torch flattens CHW, flax
+    flattens HWC — conversion must permute, not just transpose (shapes
+    alone match silently). Checked functionally: torch-side matmul on the
+    CHW flatten equals flax-side matmul on the HWC flatten."""
+    from dptpu.models.pretrained import _from_torch
+
+    rng = np.random.RandomState(0)
+    c, h, w, o = 128, 4, 4, 3  # googlenet aux fc1 geometry
+    w_torch = rng.randn(o, c * h * w).astype(np.float32)
+    k_flax = _from_torch(w_torch, ("dense_chw", (c, h, w)))
+    x = rng.randn(1, h, w, c).astype(np.float32)  # NHWC feature map
+    y_flax = x.reshape(1, -1) @ k_flax
+    x_chw = np.transpose(x, (0, 3, 1, 2)).reshape(1, -1)  # torch flatten
+    y_torch = x_chw @ w_torch.T
+    np.testing.assert_allclose(y_flax, y_torch, rtol=1e-5)
+    # and it really is a different matrix than the naive transpose
+    assert not np.allclose(k_flax, w_torch.T)
+
+
+def test_alexnet_vgg_classifier_use_chw_kind():
+    for arch in ("alexnet", "vgg11", "vgg16_bn"):
+        _, v = _init_vars(arch)
+        kmap = torch_key_map(arch, v)
+        key = "classifier.1.weight" if arch == "alexnet" else "classifier.0.weight"
+        kind = kmap[key][2]
+        assert isinstance(kind, tuple) and kind[0] == "dense_chw", (arch, kind)
