@@ -13,7 +13,7 @@ torchvision's ``squeezenet.py``:
 * classifier: Dropout(0.5) -> 1x1 conv to num_classes -> ReLU -> global
   average pool (fully-convolutional head — no Linear).
 
-torchvision's max pools here use ``ceil_mode=True``; ``_ceil_max_pool``
+torchvision's max pools here use ``ceil_mode=True``; ``ceil_max_pool``
 reproduces that by padding the bottom/right with -inf exactly when the
 ceil-rounded output needs it. Init matches torchvision: the final conv
 N(0, 0.01), every other conv ``kaiming_uniform_`` (bound sqrt(6/fan_in)),
