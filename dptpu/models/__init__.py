@@ -7,6 +7,7 @@ module-dict discovery, imagenet_ddp.py:19-21). ``model_names()`` and
 
 from dptpu.models import alexnet as _alexnet  # noqa: F401
 from dptpu.models import densenet as _densenet  # noqa: F401
+from dptpu.models import efficientnet as _efficientnet  # noqa: F401
 from dptpu.models import googlenet as _googlenet  # noqa: F401
 from dptpu.models import inception as _inception  # noqa: F401
 from dptpu.models import mnasnet as _mnasnet  # noqa: F401

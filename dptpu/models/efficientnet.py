@@ -1,0 +1,271 @@
+"""EfficientNet B0-B7 + V2 S/M/L, torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a efficientnet_b0``).
+Fresh Flax build of torchvision's ``efficientnet.py``:
+
+* v1 scales one base table of MBConv blocks (expand 1x1 -> depthwise k×k
+  -> squeeze-excitation -> project 1x1, SiLU activations) by per-variant
+  width/depth multipliers, channels rounded via ``_make_divisible(c, 8)``
+  and depths via ``ceil(n * depth_mult)``;
+* v2 uses explicit per-variant tables whose early stages are FusedMBConv
+  (single k×k expand conv, no depthwise / no SE);
+* squeeze-excitation reduces to ``max(1, block_input // 4)`` channels
+  (the BLOCK input, not the expanded width), SiLU then sigmoid gate;
+* residual blocks apply row-mode stochastic depth with probability
+  ``0.2 * block_id / total_blocks``;
+* head 1x1 conv BN SiLU -> global average pool -> Dropout -> Linear.
+
+BatchNorm eps/momentum follow torchvision: defaults for B0-B4, (1e-3,
+0.01) for B5-B7, eps 1e-3 for V2. Init matches torchvision: convs
+kaiming-normal fan-out, BN 1/0, classifier U(±1/sqrt(out_features)) with
+zero bias. Param counts locked in tests/test_models.py.
+"""
+
+import math
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    SqueezeExcite,
+    StochasticDepth,
+    kaiming_normal_fan_out,
+    uniform_bound_init,
+)
+from dptpu.models.mobilenet import _make_divisible
+from dptpu.models.registry import register_model
+
+# Base (B0) MBConv table: (expand, kernel, stride, in, out, layers).
+_V1_BASE = (
+    (1, 3, 1, 32, 16, 1),
+    (6, 3, 2, 16, 24, 2),
+    (6, 5, 2, 24, 40, 2),
+    (6, 3, 2, 40, 80, 3),
+    (6, 5, 1, 80, 112, 3),
+    (6, 5, 2, 112, 192, 4),
+    (6, 3, 1, 192, 320, 1),
+)
+# name -> (width_mult, depth_mult, dropout, bn_eps, bn_momentum[torch])
+_V1_VARIANTS = {
+    "b0": (1.0, 1.0, 0.2, 1e-5, 0.1),
+    "b1": (1.0, 1.1, 0.2, 1e-5, 0.1),
+    "b2": (1.1, 1.2, 0.3, 1e-5, 0.1),
+    "b3": (1.2, 1.4, 0.3, 1e-5, 0.1),
+    "b4": (1.4, 1.8, 0.4, 1e-5, 0.1),
+    "b5": (1.6, 2.2, 0.4, 1e-3, 0.01),
+    "b6": (1.8, 2.6, 0.5, 1e-3, 0.01),
+    "b7": (2.0, 3.1, 0.5, 1e-3, 0.01),
+}
+# V2 tables: (kind, expand, kernel, stride, in, out, layers)
+_V2_TABLES = {
+    "v2_s": (
+        ("fused", 1, 3, 1, 24, 24, 2),
+        ("fused", 4, 3, 2, 24, 48, 4),
+        ("fused", 4, 3, 2, 48, 64, 4),
+        ("mb", 4, 3, 2, 64, 128, 6),
+        ("mb", 6, 3, 1, 128, 160, 9),
+        ("mb", 6, 3, 2, 160, 256, 15),
+    ),
+    "v2_m": (
+        ("fused", 1, 3, 1, 24, 24, 3),
+        ("fused", 4, 3, 2, 24, 48, 5),
+        ("fused", 4, 3, 2, 48, 80, 5),
+        ("mb", 4, 3, 2, 80, 160, 7),
+        ("mb", 6, 3, 1, 160, 176, 14),
+        ("mb", 6, 3, 2, 176, 304, 18),
+        ("mb", 6, 3, 1, 304, 512, 5),
+    ),
+    "v2_l": (
+        ("fused", 1, 3, 1, 32, 32, 4),
+        ("fused", 4, 3, 2, 32, 64, 7),
+        ("fused", 4, 3, 2, 64, 96, 7),
+        ("mb", 4, 3, 2, 96, 192, 10),
+        ("mb", 6, 3, 1, 192, 224, 19),
+        ("mb", 6, 3, 2, 224, 384, 25),
+        ("mb", 6, 3, 1, 384, 640, 7),
+    ),
+}
+_V2_DROPOUT = {"v2_s": 0.2, "v2_m": 0.3, "v2_l": 0.4}
+
+
+def block_table(variant: str):
+    """Expanded per-block config: list of stages, each a list of
+    (kind, expand, kernel, stride, in, out). Shared with the torchvision
+    key mapping in dptpu/models/pretrained.py."""
+    if variant.startswith("v2"):
+        stages = []
+        for kind, e, k, s, ci, co, n in _V2_TABLES[variant]:
+            blocks = []
+            for i in range(n):
+                blocks.append(
+                    (kind, e, k, s if i == 0 else 1, ci if i == 0 else co, co)
+                )
+            stages.append(blocks)
+        return stages
+    width, depth, _, _, _ = _V1_VARIANTS[variant]
+    adjust = lambda c: _make_divisible(c * width, 8)
+    stages = []
+    for e, k, s, ci, co, n in _V1_BASE:
+        ci, co = adjust(ci), adjust(co)
+        blocks = []
+        for i in range(int(math.ceil(n * depth))):
+            blocks.append(
+                ("mb", e, k, s if i == 0 else 1, ci if i == 0 else co, co)
+            )
+        stages.append(blocks)
+    return stages
+
+
+def head_channels(variant: str) -> Tuple[int, int]:
+    """(stem_channels, last_conv_channels) per torchvision's builder."""
+    if variant.startswith("v2"):
+        return _V2_TABLES[variant][0][4], 1280
+    width = _V1_VARIANTS[variant][0]
+    adjust = lambda c: _make_divisible(c * width, 8)
+    return adjust(32), 4 * adjust(320)
+
+
+class MBConv(nn.Module):
+    expand: int
+    kernel: int
+    stride: int
+    in_ch: int
+    out_ch: int
+    sd_prob: float
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        expanded = self.in_ch * self.expand
+        y = x
+        if expanded != self.in_ch:
+            y = self.conv(expanded, (1, 1), name="expand")(y)
+            y = nn.silu(self.norm(name="expand_bn")(y))
+        k, p = self.kernel, self.kernel // 2
+        y = self.conv(
+            expanded, (k, k), strides=(self.stride, self.stride),
+            padding=((p, p), (p, p)), feature_group_count=expanded,
+            name="dw",
+        )(y)
+        y = nn.silu(self.norm(name="dw_bn")(y))
+        y = SqueezeExcite(
+            reduced=max(1, self.in_ch // 4), conv=self.conv,
+            act=nn.silu, gate=nn.sigmoid, name="se",
+        )(y)
+        y = self.conv(self.out_ch, (1, 1), name="project")(y)
+        y = self.norm(name="project_bn")(y)
+        if self.stride == 1 and self.in_ch == self.out_ch:
+            y = StochasticDepth(self.sd_prob, deterministic=not train)(y)
+            y = (x + y).astype(y.dtype)
+        return y
+
+
+class FusedMBConv(nn.Module):
+    expand: int
+    kernel: int
+    stride: int
+    in_ch: int
+    out_ch: int
+    sd_prob: float
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        expanded = self.in_ch * self.expand
+        k, p = self.kernel, self.kernel // 2
+        if expanded != self.in_ch:
+            y = self.conv(
+                expanded, (k, k), strides=(self.stride, self.stride),
+                padding=((p, p), (p, p)), name="fused",
+            )(x)
+            y = nn.silu(self.norm(name="fused_bn")(y))
+            y = self.conv(self.out_ch, (1, 1), name="project")(y)
+            y = self.norm(name="project_bn")(y)
+        else:
+            y = self.conv(
+                self.out_ch, (k, k), strides=(self.stride, self.stride),
+                padding=((p, p), (p, p)), name="fused",
+            )(x)
+            y = nn.silu(self.norm(name="fused_bn")(y))
+        if self.stride == 1 and self.in_ch == self.out_ch:
+            y = StochasticDepth(self.sd_prob, deterministic=not train)(y)
+            y = (x + y).astype(y.dtype)
+        return y
+
+
+class EfficientNet(nn.Module):
+    variant: str = "b0"
+    num_classes: int = 1000
+    stochastic_depth_rate: float = 0.2
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_normal_fan_out,
+        )
+        if self.variant.startswith("v2"):
+            eps, momentum, dropout = 1e-3, 0.1, _V2_DROPOUT[self.variant]
+        else:
+            _, _, dropout, eps, momentum = _V1_VARIANTS[self.variant]
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=1.0 - momentum,  # torch momentum -> flax convention
+            epsilon=eps,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        stages = block_table(self.variant)
+        stem_ch, last_ch = head_channels(self.variant)
+        total = sum(len(s) for s in stages)
+
+        x = conv(stem_ch, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="stem_conv")(x)
+        x = nn.silu(norm(name="stem_bn")(x))
+        block_id = 0
+        for si, stage in enumerate(stages):
+            for bi, (kind, e, k, s, ci, co) in enumerate(stage):
+                cls = FusedMBConv if kind == "fused" else MBConv
+                x = cls(
+                    expand=e, kernel=k, stride=s, in_ch=ci, out_ch=co,
+                    sd_prob=self.stochastic_depth_rate * block_id / total,
+                    conv=conv, norm=norm, name=f"stage{si}_block{bi}",
+                )(x, train)
+                block_id += 1
+        x = conv(last_ch, (1, 1), name="head_conv")(x)
+        x = nn.silu(norm(name="head_bn")(x))
+        x = x.mean(axis=(1, 2))
+        x = nn.Dropout(dropout, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=uniform_bound_init(1.0 / math.sqrt(self.num_classes)),
+            bias_init=nn.initializers.zeros,
+            name="classifier",
+        )(x)
+
+
+def _factory(variant):
+    def fn(**kw):
+        return EfficientNet(variant=variant, **kw)
+
+    fn.__name__ = f"efficientnet_{variant}"
+    return register_model(fn)
+
+
+for _v in list(_V1_VARIANTS) + list(_V2_TABLES):
+    _factory(_v)

@@ -47,12 +47,55 @@ def torch_default_kernel_init(key, shape, dtype=jnp.float32):
 
 def torch_default_bias_init(fan_in):
     """torch default bias init: U(±1/sqrt(fan_in)) with fan_in of the layer."""
-    bound = 1.0 / np.sqrt(fan_in)
+    return uniform_bound_init(1.0 / np.sqrt(fan_in))
+
+
+def uniform_bound_init(bound):
+    """U(±bound) initializer (torchvision's Linear init for EfficientNet
+    and others uses U(±1/sqrt(out_features)))."""
 
     def init(key, shape, dtype=jnp.float32):
         return jax.random.uniform(key, shape, dtype, -bound, bound)
 
     return init
+
+
+class SqueezeExcite(nn.Module):
+    """torchvision SqueezeExcitation: avg pool -> 1x1 reduce -> act ->
+    1x1 expand -> gate (convs with bias). MobileNetV3 uses relu /
+    hard_sigmoid, EfficientNet silu / sigmoid."""
+
+    reduced: int
+    conv: Any
+    act: Any = nn.relu
+    gate: Any = nn.sigmoid
+
+    @nn.compact
+    def __call__(self, x):
+        s = x.mean(axis=(1, 2), keepdims=True)
+        s = self.conv(self.reduced, (1, 1), use_bias=True, name="fc1")(s)
+        s = self.act(s)
+        s = self.conv(x.shape[-1], (1, 1), use_bias=True, name="fc2")(s)
+        return x * self.gate(s)
+
+
+class StochasticDepth(nn.Module):
+    """torchvision ``StochasticDepth(p, mode="row")``: drop a residual
+    branch per SAMPLE with probability ``p``, scaling survivors by
+    ``1/(1-p)``. Identity when deterministic or p == 0 (so it traces to
+    nothing at eval and for the un-scaled early blocks)."""
+
+    rate: float
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, x):
+        if self.deterministic or self.rate == 0.0:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("dropout")
+        mask = jax.random.bernoulli(rng, keep, (x.shape[0],) + (1,) * (x.ndim - 1))
+        return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)
 
 
 def max_pool_same_as_torch(x, window, stride, padding):

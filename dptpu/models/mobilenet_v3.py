@@ -24,7 +24,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from dptpu.models.layers import kaiming_normal_fan_out
+from dptpu.models.layers import SqueezeExcite, kaiming_normal_fan_out
 from dptpu.models.mobilenet import _make_divisible
 from dptpu.models.registry import register_model
 
@@ -67,22 +67,6 @@ def _act(kind, x):
     return nn.relu(x) if kind == "RE" else nn.hard_swish(x)
 
 
-class SqueezeExcite(nn.Module):
-    """torchvision SqueezeExcitation: avg pool -> 1x1 reduce -> ReLU ->
-    1x1 expand -> hardsigmoid gate (convs with bias)."""
-
-    reduced: int
-    conv: Any
-
-    @nn.compact
-    def __call__(self, x):
-        s = x.mean(axis=(1, 2), keepdims=True)
-        s = self.conv(self.reduced, (1, 1), use_bias=True, name="fc1")(s)
-        s = nn.relu(s)
-        s = self.conv(x.shape[-1], (1, 1), use_bias=True, name="fc2")(s)
-        return x * nn.hard_sigmoid(s)
-
-
 class Bneck(nn.Module):
     kernel: int
     expanded: int
@@ -110,7 +94,7 @@ class Bneck(nn.Module):
         if self.use_se:
             y = SqueezeExcite(
                 reduced=_make_divisible(self.expanded // 4),
-                conv=self.conv, name="se",
+                conv=self.conv, act=nn.relu, gate=nn.hard_sigmoid, name="se",
             )(y)
         y = self.conv(self.out_ch, (1, 1), name="project")(y)
         y = self.norm(name="project_bn")(y)

@@ -193,6 +193,33 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
             idx = _SQUEEZE_FIRE_IDX[version][int(head[4:])]
             return f"features.{idx}.{mod[1]}"
         return "classifier.1"  # final_conv
+    if arch.startswith("efficientnet"):
+        # torch: features.0 stem, features.{s+1}.{i}.block.* stages (the
+        # block Sequential's indices depend on expand/kind), features.{S+1}
+        # head, classifier.1 Linear
+        from dptpu.models.efficientnet import block_table
+
+        stages = block_table(arch[len("efficientnet_"):])
+        flat = {"stem_conv": "features.0.0", "stem_bn": "features.0.1",
+                "head_conv": f"features.{len(stages) + 1}.0",
+                "head_bn": f"features.{len(stages) + 1}.1",
+                "classifier": "classifier.1"}
+        if head in flat:
+            return flat[head]
+        si, bi = (int(x) for x in head[len("stage"):].split("_block"))
+        kind, e, _, _, _, _ = stages[si][bi]
+        sub = mod[1]
+        if kind == "fused":
+            m = {"fused": "block.0.0", "fused_bn": "block.0.1",
+                 "project": "block.1.0", "project_bn": "block.1.1"}
+            return f"features.{si + 1}.{bi}.{m[sub]}"
+        d = 1 if e != 1 else 0  # depthwise position after optional expand
+        if sub == "se":
+            return f"features.{si + 1}.{bi}.block.{d + 1}.{mod[2]}"
+        m = {"expand": "block.0.0", "expand_bn": "block.0.1",
+             "dw": f"block.{d}.0", "dw_bn": f"block.{d}.1",
+             "project": f"block.{d + 2}.0", "project_bn": f"block.{d + 2}.1"}
+        return f"features.{si + 1}.{bi}.{m[sub]}"
     raise ValueError(f"no torchvision key mapping for arch {arch!r}")
 
 

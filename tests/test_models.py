@@ -49,6 +49,17 @@ TORCHVISION_PARAM_COUNTS = {
     "mobilenet_v3_large": 5_483_032,
     "mobilenet_v3_small": 2_542_856,
     "googlenet": 6_624_904,
+    "efficientnet_b0": 5_288_548,
+    "efficientnet_b1": 7_794_184,
+    "efficientnet_b2": 9_109_994,
+    "efficientnet_b3": 12_233_232,
+    "efficientnet_b4": 19_341_616,
+    "efficientnet_b5": 30_389_784,
+    "efficientnet_b6": 43_040_704,
+    "efficientnet_b7": 66_347_960,
+    "efficientnet_v2_s": 21_458_488,
+    "efficientnet_v2_m": 54_139_356,
+    "efficientnet_v2_l": 118_515_272,
 }
 
 
@@ -84,6 +95,7 @@ def test_param_counts_match_torchvision(name):
 @pytest.mark.parametrize("name,image", [
     ("vgg11_bn", 224), ("mnasnet0_5", 64), ("resnext50_32x4d", 64),
     ("wide_resnet50_2", 64), ("alexnet", 224), ("mobilenet_v3_small", 64),
+    ("efficientnet_b0", 64), ("efficientnet_v2_s", 64),
 ])
 def test_family_concrete_init_and_forward(name, image):
     """One CONCRETE init+forward per family not covered elsewhere:

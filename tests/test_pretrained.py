@@ -30,7 +30,8 @@ def _init_vars(arch, num_classes=10, image=None):
         # vgg/alexnet/squeezenet need full-size inputs (fixed-grid pools)
         image = (32 if arch.startswith(("resnet", "densenet", "mobilenet",
                                          "wide_resnet", "resnext",
-                                         "shufflenet", "mnasnet"))
+                                         "shufflenet", "mnasnet",
+                                         "efficientnet"))
                  else 224)
     model = create_model(arch, num_classes=num_classes)
     # key maps / fake state dicts / conversion templates only need SHAPES:
@@ -68,7 +69,8 @@ def _fake_torch_sd(arch, variables, rng):
                                   "resnext50_32x4d", "wide_resnet50_2",
                                   "mobilenet_v2", "shufflenet_v2_x1_0",
                                   "mnasnet1_0", "mobilenet_v3_large",
-                                  "mobilenet_v3_small", "googlenet"])
+                                  "mobilenet_v3_small", "googlenet",
+                                  "efficientnet_b0", "efficientnet_v2_s"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
@@ -100,6 +102,27 @@ def test_key_map_matches_known_torchvision_names():
     _, v = _init_vars("alexnet", image=224)
     keys = torch_key_map("alexnet", v)
     assert "features.0.weight" in keys and "classifier.6.bias" in keys
+    _, v = _init_vars("efficientnet_b0", image=32)
+    keys = torch_key_map("efficientnet_b0", v)
+    for k in ("features.0.0.weight",  # stem conv
+              # stage 0 (no expand): dw at block.0, SE block.1, proj block.2
+              "features.1.0.block.0.0.weight",
+              "features.1.0.block.1.fc1.bias",
+              "features.1.0.block.2.1.running_mean",
+              # stage 1 (expand 6): expand block.0, dw block.1, SE block.2,
+              # project block.3
+              "features.2.0.block.0.0.weight",
+              "features.2.1.block.3.0.weight",
+              "features.8.1.weight",  # head bn
+              "classifier.1.weight"):
+        assert k in keys, k
+    _, v = _init_vars("efficientnet_v2_s", image=32)
+    keys = torch_key_map("efficientnet_v2_s", v)
+    for k in ("features.1.0.block.0.0.weight",   # fused, expand 1: one conv
+              "features.2.0.block.1.0.weight",   # fused, expand 4: project
+              "features.4.0.block.1.1.running_var",  # MBConv dw bn
+              "classifier.1.bias"):
+        assert k in keys, k
 
 
 def test_convert_round_trip_resnet18():
