@@ -13,10 +13,12 @@ from dptpu.models import inception as _inception  # noqa: F401
 from dptpu.models import mnasnet as _mnasnet  # noqa: F401
 from dptpu.models import mobilenet as _mobilenet  # noqa: F401
 from dptpu.models import mobilenet_v3 as _mobilenet_v3  # noqa: F401
+from dptpu.models import regnet as _regnet  # noqa: F401
 from dptpu.models import resnet as _resnet  # noqa: F401
 from dptpu.models import shufflenet as _shufflenet  # noqa: F401
 from dptpu.models import squeezenet as _squeezenet  # noqa: F401
 from dptpu.models import vgg as _vgg  # noqa: F401
+from dptpu.models import vit as _vit  # noqa: F401
 from dptpu.models.registry import create_model, model_names, register_model
 
 __all__ = ["create_model", "model_names", "register_model"]

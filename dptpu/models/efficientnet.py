@@ -35,7 +35,7 @@ from dptpu.models.layers import (
     uniform_bound_init,
 )
 from dptpu.models.mobilenet import _make_divisible
-from dptpu.models.registry import register_model
+from dptpu.models.registry import register_variants
 
 # Base (B0) MBConv table: (expand, kernel, stride, in, out, layers).
 _V1_BASE = (
@@ -259,13 +259,6 @@ class EfficientNet(nn.Module):
         )(x)
 
 
-def _factory(variant):
-    def fn(**kw):
-        return EfficientNet(variant=variant, **kw)
-
-    fn.__name__ = f"efficientnet_{variant}"
-    return register_model(fn)
-
-
-for _v in list(_V1_VARIANTS) + list(_V2_TABLES):
-    _factory(_v)
+register_variants(
+    EfficientNet, "efficientnet", list(_V1_VARIANTS) + list(_V2_TABLES)
+)

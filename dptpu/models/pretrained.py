@@ -60,8 +60,36 @@ _SQUEEZE_FIRE_IDX = {
 }
 
 
+def _vit_torch_module(mod: Tuple[str, ...]) -> str:
+    """ViT paths. torch: conv_proj, raw class_token /
+    encoder.pos_embedding Parameters, encoder.layers.encoder_layer_{i}
+    with ln_1 / self_attention (raw fused in_proj_weight + out_proj
+    Linear) / ln_2 / mlp (Sequential: Linears at 0 and 3), encoder.ln,
+    heads.head. "{}"-bearing returns are formatted with the torch leaf
+    name by torch_key_map (raw-Parameter keys have no ".weight" suffix).
+    """
+    if not mod:
+        return "{}"  # class_token
+    if mod[0] in ("conv_proj", "head"):
+        return {"conv_proj": "conv_proj", "head": "heads.head"}[mod[0]]
+    if len(mod) == 1:
+        return "encoder.{}"  # pos_embedding
+    if mod[1] == "ln":
+        return "encoder.ln"
+    base = f"encoder.layers.{mod[1]}"
+    sub = mod[2]
+    if sub == "self_attention":
+        if mod[3] == "in_proj":
+            return f"{base}.self_attention.in_proj_{{}}"
+        return f"{base}.self_attention.out_proj"
+    m = {"ln_1": "ln_1", "ln_2": "ln_2", "mlp_1": "mlp.0", "mlp_2": "mlp.3"}
+    return f"{base}.{m[sub]}"
+
+
 def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
     """Map a dptpu module path (tuple of names) to the torch module path."""
+    if arch.startswith("vit_"):
+        return _vit_torch_module(mod)
     head = mod[0]
     if arch.startswith(("resnet", "wide_resnet", "resnext")):
         if head.startswith("layer"):
@@ -220,6 +248,22 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
              "dw": f"block.{d}.0", "dw_bn": f"block.{d}.1",
              "project": f"block.{d + 2}.0", "project_bn": f"block.{d + 2}.1"}
         return f"features.{si + 1}.{bi}.{m[sub]}"
+    if arch.startswith("regnet"):
+        # torch: stem Conv2dNormActivation, trunk_output.block{s+1} stages
+        # of blocks named "block{s+1}-{i}", BottleneckTransform under .f
+        # with a/b/se/c members, head Linear at fc
+        flat = {"stem_conv": "stem.0", "stem_bn": "stem.1", "fc": "fc"}
+        if head in flat:
+            return flat[head]
+        si, bi = (int(x) for x in head[len("stage"):].split("_block"))
+        base = f"trunk_output.block{si + 1}.block{si + 1}-{bi}"
+        sub = mod[1]
+        if sub == "se":
+            return f"{base}.f.se.{mod[2]}"
+        m = {"proj": "proj.0", "proj_bn": "proj.1",
+             "a": "f.a.0", "a_bn": "f.a.1", "b": "f.b.0", "b_bn": "f.b.1",
+             "c": "f.c.0", "c_bn": "f.c.1"}
+        return f"{base}.{m[sub]}"
     raise ValueError(f"no torchvision key mapping for arch {arch!r}")
 
 
@@ -236,7 +280,13 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
         for path, leaf in flat:
             names = tuple(p.key for p in path)
             tmod = _torch_module(arch, names[:-1])
-            tleaf = _LEAF_TO_TORCH[names[-1]]
+            if "{}" in tmod:
+                # raw torch Parameters (ViT class_token / pos_embedding)
+                # keep their own leaf name inside the "{}" template; all
+                # other leaves stay on the strict whitelist
+                tleaf = _LEAF_TO_TORCH.get(names[-1], names[-1])
+            else:
+                tleaf = _LEAF_TO_TORCH[names[-1]]
             if names[-1] == "kernel":
                 if leaf.ndim == 4:
                     kind = "conv"
@@ -246,7 +296,7 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
                     kind = ("dense_chw", chw) if chw else "dense"
             else:
                 kind = "direct"
-            key = f"{tmod}.{tleaf}"
+            key = tmod.format(tleaf) if "{}" in tmod else f"{tmod}.{tleaf}"
             assert key not in out, f"duplicate torch key {key}"
             out[key] = (collection, names, kind)
     return out

@@ -19,6 +19,17 @@ def register_model(fn):
     return fn
 
 
+def register_variants(model_cls, prefix, variants, field="variant"):
+    """Register ``{prefix}_{v}`` factories for a config-parameterized
+    model class (EfficientNet/RegNet/ViT-style variant tables)."""
+    for v in variants:
+        def fn(_v=v, **kw):
+            return model_cls(**{field: _v}, **kw)
+
+        fn.__name__ = f"{prefix}_{v}"
+        register_model(fn)
+
+
 def model_names():
     """Sorted architecture names (imagenet_ddp.py:19-21 semantics)."""
     return sorted(_REGISTRY)

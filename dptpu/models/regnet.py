@@ -1,0 +1,164 @@
+"""RegNet X / Y families, torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a regnet_y_400mf``).
+Fresh Flax build of torchvision's ``regnet.py`` (the pycls "Designing
+Network Design Spaces" recipe):
+
+* stage widths/depths are GENERATED, not tabulated: a linear width ramp
+  ``w_0 + w_a * j`` is quantized onto the log grid ``w_0 * w_m^k``,
+  snapped to multiples of 8, and consecutive equal widths merge into
+  stages; widths are then rounded to be divisible by the (possibly
+  clamped) group width;
+* stem 3x3/2 conv(32) BN ReLU; every stage opens with a stride-2 block;
+* ResBottleneckBlock: 1x1 conv BN ReLU -> 3x3 GROUP conv BN ReLU ->
+  optional squeeze-excitation (Y models, reduce to
+  ``round(0.25 * block_input)``, ReLU -> sigmoid) -> 1x1 conv BN, with a
+  1x1/stride-2 BN projection shortcut whenever shape changes, ReLU after
+  the residual add;
+* head: global average pool -> Linear.
+
+Init matches torchvision: convs N(0, sqrt(2/(k*k*out))) (== kaiming
+fan-out), BN 1/0, Linear N(0, 0.01) with zero bias. Param counts locked
+in tests/test_models.py.
+"""
+
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dptpu.models.layers import SqueezeExcite, kaiming_normal_fan_out
+from dptpu.models.mobilenet import _make_divisible
+from dptpu.models.registry import register_variants
+
+# name -> (depth, w_0, w_a, w_m, group_width, se_ratio)
+_VARIANTS = {
+    "x_400mf": (22, 24, 24.48, 2.54, 16, None),
+    "x_800mf": (16, 56, 35.73, 2.28, 16, None),
+    "x_1_6gf": (18, 80, 34.01, 2.25, 24, None),
+    "x_3_2gf": (25, 88, 26.31, 2.25, 48, None),
+    "x_8gf": (23, 80, 49.56, 2.88, 120, None),
+    "x_16gf": (22, 216, 55.59, 2.1, 128, None),
+    "x_32gf": (23, 320, 69.86, 2.0, 168, None),
+    "y_400mf": (16, 48, 27.89, 2.09, 8, 0.25),
+    "y_800mf": (14, 56, 38.84, 2.4, 16, 0.25),
+    "y_1_6gf": (27, 48, 20.71, 2.65, 24, 0.25),
+    "y_3_2gf": (21, 80, 42.63, 2.66, 24, 0.25),
+    "y_8gf": (17, 192, 76.82, 2.19, 56, 0.25),
+    "y_16gf": (18, 200, 106.23, 2.48, 112, 0.25),
+    "y_32gf": (20, 232, 115.89, 2.53, 232, 0.25),
+    "y_128gf": (27, 456, 160.83, 2.52, 264, 0.25),
+}
+
+
+def stage_params(variant: str):
+    """[(width, depth, group_width)] per stage — torchvision's
+    ``BlockParams.from_init_params`` + group-compatibility adjustment."""
+    depth, w_0, w_a, w_m, group, _ = _VARIANTS[variant]
+    ramp = w_0 + w_a * np.arange(depth)
+    k = np.round(np.log(ramp / w_0) / math.log(w_m))
+    widths = (np.round(w_0 * np.power(w_m, k) / 8) * 8).astype(int)
+    stages = []  # consecutive equal widths merge into one stage
+    for w in widths:
+        if stages and stages[-1][0] == w:
+            stages[-1][1] += 1
+        else:
+            stages.append([int(w), 1])
+    out = []
+    for w, d in stages:
+        g = min(group, w)  # bottleneck_multiplier = 1: w_bot == w
+        out.append((_make_divisible(w, g), d, g))
+    return out
+
+
+class ResBottleneckBlock(nn.Module):
+    w_in: int
+    w_out: int
+    stride: int
+    group_width: int
+    se_ratio: Optional[float]
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        shortcut = x
+        if self.w_in != self.w_out or self.stride != 1:
+            shortcut = self.conv(
+                self.w_out, (1, 1), strides=(self.stride, self.stride),
+                name="proj",
+            )(x)
+            shortcut = self.norm(name="proj_bn")(shortcut)
+        y = self.conv(self.w_out, (1, 1), name="a")(x)
+        y = nn.relu(self.norm(name="a_bn")(y))
+        y = self.conv(
+            self.w_out, (3, 3), strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)),
+            feature_group_count=self.w_out // self.group_width, name="b",
+        )(y)
+        y = nn.relu(self.norm(name="b_bn")(y))
+        if self.se_ratio is not None:
+            y = SqueezeExcite(
+                reduced=int(round(self.se_ratio * self.w_in)),
+                conv=self.conv, act=nn.relu, gate=nn.sigmoid, name="se",
+            )(y)
+        y = self.conv(self.w_out, (1, 1), name="c")(y)
+        y = self.norm(name="c_bn")(y)
+        return nn.relu((shortcut + y).astype(y.dtype))
+
+
+class RegNet(nn.Module):
+    variant: str = "y_400mf"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    bn_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=kaiming_normal_fan_out,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        se_ratio = _VARIANTS[self.variant][5]
+        x = conv(32, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="stem_conv")(x)
+        x = nn.relu(norm(name="stem_bn")(x))
+        w_in = 32
+        for si, (w, d, g) in enumerate(stage_params(self.variant)):
+            for bi in range(d):
+                x = ResBottleneckBlock(
+                    w_in=w_in if bi == 0 else w, w_out=w,
+                    stride=2 if bi == 0 else 1, group_width=g,
+                    se_ratio=se_ratio, conv=conv, norm=norm,
+                    name=f"stage{si}_block{bi}",
+                )(x)
+            w_in = w
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(0.01),
+            bias_init=nn.initializers.zeros,
+            name="fc",
+        )(x)
+
+
+register_variants(RegNet, "regnet", _VARIANTS)

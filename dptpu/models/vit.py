@@ -1,0 +1,188 @@
+"""Vision Transformer (ViT B/L/H), torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a vit_b_16``). Fresh
+Flax build of torchvision's ``vision_transformer.py``:
+
+* patchify via a patch-size/patch-stride conv WITH bias, flattened
+  row-major over the spatial grid (the same order torch's
+  ``reshape(B, hidden, S).permute`` produces, so converted pos
+  embeddings line up);
+* learned class token (zeros init) prepended, learned position
+  embedding (N(0, 0.02)) added over the ``S + 1`` sequence;
+* pre-LN encoder layers (LayerNorm eps 1e-6): LN -> multi-head
+  self-attention (one fused qkv projection == torch's
+  ``in_proj_weight``, out projection) -> residual; LN -> MLP
+  (Linear -> GELU -> Linear, xavier-uniform weights, N(0, 1e-6)
+  biases) -> residual;
+* final LN, classify from the class token through a ZERO-initialized
+  Linear head (torchvision zero-inits ``heads.head``).
+
+The attention is a plain scaled-dot-product in jnp — two einsums around
+a softmax — which XLA maps straight onto the MXU; the fused qkv keeps
+it one big matmul per layer. Param counts locked in
+tests/test_models.py (vit_b_16 at 224 = 86,567,656).
+"""
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import uniform_bound_init
+from dptpu.models.registry import register_variants
+
+# name -> (patch, layers, heads, hidden, mlp)
+_VARIANTS = {
+    "b_16": (16, 12, 12, 768, 3072),
+    "b_32": (32, 12, 12, 768, 3072),
+    "l_16": (16, 24, 16, 1024, 4096),
+    "l_32": (32, 24, 16, 1024, 4096),
+    "h_14": (14, 32, 16, 1280, 5120),
+}
+
+
+# torch's xavier_uniform_: U(±sqrt(6/(fan_in+fan_out))) — identical to
+# flax's for the 2-D Dense kernels it is applied to
+xavier_uniform = nn.initializers.xavier_uniform()
+
+
+class SelfAttention(nn.Module):
+    """torch ``nn.MultiheadAttention`` semantics: fused qkv projection
+    (xavier-uniform, zero bias), scaled dot-product, out projection
+    (torch Linear default init, zero bias)."""
+
+    heads: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        h = x.shape[-1]
+        hd = h // self.heads
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+        qkv = dense(
+            3 * h, kernel_init=xavier_uniform,
+            bias_init=nn.initializers.zeros, name="in_proj",
+        )(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(t.shape[:-1] + (self.heads, hd))
+        q, k, v = split(q), split(k), split(v)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+        attn = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        y = y.reshape(y.shape[:-2] + (h,))
+        return dense(
+            h,
+            kernel_init=uniform_bound_init(1.0 / math.sqrt(h)),
+            bias_init=nn.initializers.zeros,
+            name="out_proj",
+        )(y)
+
+
+class EncoderLayer(nn.Module):
+    heads: int
+    mlp_dim: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        ln = partial(
+            nn.LayerNorm, epsilon=1e-6, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=xavier_uniform,
+            bias_init=nn.initializers.normal(1e-6),
+        )
+        y = ln(name="ln_1")(x)
+        y = SelfAttention(
+            heads=self.heads, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="self_attention",
+        )(y)
+        x = x + y
+        y = ln(name="ln_2")(x)
+        y = dense(self.mlp_dim, name="mlp_1")(y)
+        y = nn.gelu(y, approximate=False)
+        y = dense(x.shape[-1], name="mlp_2")(y)
+        return x + y
+
+
+class Encoder(nn.Module):
+    layers: int
+    heads: int
+    mlp_dim: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (1, x.shape[1], x.shape[2]), jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+        for i in range(self.layers):
+            x = EncoderLayer(
+                heads=self.heads, mlp_dim=self.mlp_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"encoder_layer_{i}",
+            )(x)
+        return nn.LayerNorm(
+            epsilon=1e-6, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="ln",
+        )(x)
+
+
+class VisionTransformer(nn.Module):
+    variant: str = "b_16"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Any = None  # no BN; accepted for API uniformity
+    bn_dtype: Any = None  # likewise
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        patch, layers, heads, hidden, mlp = _VARIANTS[self.variant]
+        n, h, w, _ = x.shape
+        if h % patch or w % patch:
+            raise ValueError(
+                f"vit_{self.variant} needs image size divisible by {patch}"
+            )
+        fan_in = 3 * patch * patch
+        x = nn.Conv(
+            hidden, (patch, patch), strides=(patch, patch), padding="VALID",
+            use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.truncated_normal(
+                math.sqrt(1.0 / fan_in)
+            ),
+            bias_init=nn.initializers.zeros,
+            name="conv_proj",
+        )(x)
+        x = x.reshape(n, -1, hidden)  # row-major spatial flatten == torch
+        cls = self.param(
+            "class_token", nn.initializers.zeros, (1, 1, hidden), jnp.float32
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype), (n, 1, hidden)), x], axis=1
+        )
+        x = Encoder(
+            layers=layers, heads=heads, mlp_dim=mlp, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="encoder",
+        )(x)
+        return nn.Dense(
+            self.num_classes,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.zeros,
+            bias_init=nn.initializers.zeros,
+            name="head",
+        )(x[:, 0])
+
+
+register_variants(VisionTransformer, "vit", _VARIANTS)

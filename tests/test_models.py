@@ -60,6 +60,27 @@ TORCHVISION_PARAM_COUNTS = {
     "efficientnet_v2_s": 21_458_488,
     "efficientnet_v2_m": 54_139_356,
     "efficientnet_v2_l": 118_515_272,
+    "regnet_x_400mf": 5_495_976,
+    "regnet_x_800mf": 7_259_656,
+    "regnet_x_1_6gf": 9_190_136,
+    "regnet_x_3_2gf": 15_296_552,
+    "regnet_x_8gf": 39_572_648,
+    "regnet_x_16gf": 54_278_536,
+    "regnet_x_32gf": 107_811_560,
+    "regnet_y_400mf": 4_344_144,
+    "regnet_y_800mf": 6_432_512,
+    "regnet_y_1_6gf": 11_202_430,
+    "regnet_y_3_2gf": 19_436_338,
+    "regnet_y_8gf": 39_381_472,
+    "regnet_y_16gf": 83_590_140,
+    "regnet_y_32gf": 145_046_770,
+    "regnet_y_128gf": 644_812_894,
+    # ViT counts are image-size dependent (pos embedding); locked at 224
+    "vit_b_16": 86_567_656,
+    "vit_b_32": 88_224_232,
+    "vit_l_16": 304_326_632,
+    "vit_l_32": 306_535_400,
+    "vit_h_14": 632_045_800,
 }
 
 
@@ -88,7 +109,8 @@ def _param_count(name, image=64):
 
 @pytest.mark.parametrize("name", sorted(TORCHVISION_PARAM_COUNTS))
 def test_param_counts_match_torchvision(name):
-    image = 224 if name.startswith(("alexnet", "vgg", "squeezenet")) else 64
+    image = (224 if name.startswith(("alexnet", "vgg", "squeezenet", "vit"))
+             else 64)
     assert _param_count(name, image) == TORCHVISION_PARAM_COUNTS[name]
 
 
@@ -96,6 +118,7 @@ def test_param_counts_match_torchvision(name):
     ("vgg11_bn", 224), ("mnasnet0_5", 64), ("resnext50_32x4d", 64),
     ("wide_resnet50_2", 64), ("alexnet", 224), ("mobilenet_v3_small", 64),
     ("efficientnet_b0", 64), ("efficientnet_v2_s", 64),
+    ("regnet_y_400mf", 64), ("regnet_x_400mf", 64), ("vit_b_32", 64),
 ])
 def test_family_concrete_init_and_forward(name, image):
     """One CONCRETE init+forward per family not covered elsewhere:

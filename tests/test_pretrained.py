@@ -31,7 +31,7 @@ def _init_vars(arch, num_classes=10, image=None):
         image = (32 if arch.startswith(("resnet", "densenet", "mobilenet",
                                          "wide_resnet", "resnext",
                                          "shufflenet", "mnasnet",
-                                         "efficientnet"))
+                                         "efficientnet", "regnet"))
                  else 224)
     model = create_model(arch, num_classes=num_classes)
     # key maps / fake state dicts / conversion templates only need SHAPES:
@@ -70,7 +70,9 @@ def _fake_torch_sd(arch, variables, rng):
                                   "mobilenet_v2", "shufflenet_v2_x1_0",
                                   "mnasnet1_0", "mobilenet_v3_large",
                                   "mobilenet_v3_small", "googlenet",
-                                  "efficientnet_b0", "efficientnet_v2_s"])
+                                  "efficientnet_b0", "efficientnet_v2_s",
+                                  "regnet_y_400mf", "regnet_x_800mf",
+                                  "vit_b_32"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
@@ -123,6 +125,29 @@ def test_key_map_matches_known_torchvision_names():
               "features.4.0.block.1.1.running_var",  # MBConv dw bn
               "classifier.1.bias"):
         assert k in keys, k
+    _, v = _init_vars("regnet_y_400mf", image=32)
+    keys = torch_key_map("regnet_y_400mf", v)
+    for k in ("stem.0.weight", "stem.1.running_mean",
+              "trunk_output.block1.block1-0.proj.0.weight",
+              "trunk_output.block1.block1-0.f.a.0.weight",
+              "trunk_output.block1.block1-0.f.se.fc1.bias",
+              "trunk_output.block4.block4-5.f.c.1.weight",
+              "fc.weight"):
+        assert k in keys, k
+    _, v = _init_vars("vit_b_32", image=64)
+    keys = torch_key_map("vit_b_32", v)
+    for k in ("class_token", "conv_proj.weight", "encoder.pos_embedding",
+              "encoder.layers.encoder_layer_0.ln_1.weight",
+              "encoder.layers.encoder_layer_0.self_attention.in_proj_weight",
+              "encoder.layers.encoder_layer_0.self_attention.in_proj_bias",
+              "encoder.layers.encoder_layer_0.self_attention.out_proj.weight",
+              "encoder.layers.encoder_layer_11.mlp.0.weight",
+              "encoder.layers.encoder_layer_11.mlp.3.bias",
+              "encoder.ln.weight", "heads.head.weight"):
+        assert k in keys, k
+    # the fused in_proj is a raw Parameter: no ".weight"-suffixed variant
+    assert "encoder.layers.encoder_layer_0.self_attention.in_proj.weight" \
+        not in keys
 
 
 def test_convert_round_trip_resnet18():
