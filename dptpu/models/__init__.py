@@ -6,6 +6,7 @@ module-dict discovery, imagenet_ddp.py:19-21). ``model_names()`` and
 """
 
 from dptpu.models import alexnet as _alexnet  # noqa: F401
+from dptpu.models import convnext as _convnext  # noqa: F401
 from dptpu.models import densenet as _densenet  # noqa: F401
 from dptpu.models import efficientnet as _efficientnet  # noqa: F401
 from dptpu.models import googlenet as _googlenet  # noqa: F401
@@ -17,6 +18,7 @@ from dptpu.models import regnet as _regnet  # noqa: F401
 from dptpu.models import resnet as _resnet  # noqa: F401
 from dptpu.models import shufflenet as _shufflenet  # noqa: F401
 from dptpu.models import squeezenet as _squeezenet  # noqa: F401
+from dptpu.models import swin as _swin  # noqa: F401
 from dptpu.models import vgg as _vgg  # noqa: F401
 from dptpu.models import vit as _vit  # noqa: F401
 from dptpu.models.registry import create_model, model_names, register_model

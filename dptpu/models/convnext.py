@@ -1,0 +1,123 @@
+"""ConvNeXt tiny/small/base/large, torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a convnext_tiny``).
+Fresh Flax build of torchvision's ``convnext.py``:
+
+* stem 4x4/4 conv WITH bias + LayerNorm (eps 1e-6);
+* four stages of CNBlocks with 2x2/2 LayerNorm+conv downsampling
+  between them;
+* CNBlock: 7x7 depthwise conv (bias) -> LayerNorm -> Linear 4x -> GELU
+  -> Linear back -> per-channel layer scale (init 1e-6) -> row-mode
+  stochastic depth -> residual. In NHWC the torch Permute pair around
+  the LN/Linear sandwich disappears — the whole block is already
+  channels-last;
+* head: global average pool -> LayerNorm -> Linear.
+
+Stochastic depth probability ramps to the per-variant rate as
+``rate * block_id / (total - 1)``. Init matches torchvision:
+trunc_normal(0.02) conv/linear kernels, zero biases. Param counts
+locked in tests/test_models.py (tiny = 28,589,128).
+"""
+
+from functools import partial
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import StochasticDepth, torch_trunc_normal_init
+from dptpu.models.registry import register_variants
+
+# name -> (dims, depths, stochastic_depth_rate)
+_VARIANTS = {
+    "tiny": ((96, 192, 384, 768), (3, 3, 9, 3), 0.1),
+    "small": ((96, 192, 384, 768), (3, 3, 27, 3), 0.4),
+    "base": ((128, 256, 512, 1024), (3, 3, 27, 3), 0.5),
+    "large": ((192, 384, 768, 1536), (3, 3, 27, 3), 0.5),
+}
+
+_trunc02 = torch_trunc_normal_init(0.02)
+
+
+class CNBlock(nn.Module):
+    dim: int
+    sd_prob: float
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        y = nn.Conv(
+            self.dim, (7, 7), padding=((3, 3), (3, 3)),
+            feature_group_count=self.dim, use_bias=True,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_trunc02, bias_init=nn.initializers.zeros,
+            name="dw",
+        )(x)
+        y = nn.LayerNorm(
+            epsilon=1e-6, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="norm",
+        )(y)
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_trunc02, bias_init=nn.initializers.zeros,
+        )
+        y = dense(4 * self.dim, name="mlp_1")(y)
+        y = nn.gelu(y, approximate=False)
+        y = dense(self.dim, name="mlp_2")(y)
+        scale = self.param(
+            "layer_scale",
+            nn.initializers.constant(1e-6), (self.dim,), jnp.float32,
+        )
+        y = y * scale.astype(y.dtype)
+        y = StochasticDepth(self.sd_prob, deterministic=not train)(y)
+        return (x + y).astype(y.dtype)
+
+
+class ConvNeXt(nn.Module):
+    variant: str = "tiny"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Any = None  # no BN; accepted for API uniformity
+    bn_dtype: Any = None  # likewise
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dims, depths, sd_rate = _VARIANTS[self.variant]
+        ln = partial(
+            nn.LayerNorm, epsilon=1e-6, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        conv = partial(
+            nn.Conv, use_bias=True, dtype=self.dtype,
+            param_dtype=self.param_dtype, kernel_init=_trunc02,
+            bias_init=nn.initializers.zeros,
+        )
+        x = conv(dims[0], (4, 4), strides=(4, 4), padding="VALID",
+                 name="stem_conv")(x)
+        x = ln(name="stem_norm")(x)
+        total = sum(depths)
+        block_id = 0
+        for si, (dim, depth) in enumerate(zip(dims, depths)):
+            if si:
+                x = ln(name=f"downsample{si}_norm")(x)
+                x = conv(dim, (2, 2), strides=(2, 2), padding="VALID",
+                         name=f"downsample{si}_conv")(x)
+            for bi in range(depth):
+                x = CNBlock(
+                    dim=dim, sd_prob=sd_rate * block_id / (total - 1.0),
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name=f"stage{si}_block{bi}",
+                )(x, train)
+                block_id += 1
+        x = x.mean(axis=(1, 2))
+        x = ln(name="head_norm")(x)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_trunc02, bias_init=nn.initializers.zeros,
+            name="head",
+        )(x)
+
+
+register_variants(ConvNeXt, "convnext", _VARIANTS)

@@ -50,6 +50,20 @@ def torch_default_bias_init(fan_in):
     return uniform_bound_init(1.0 / np.sqrt(fan_in))
 
 
+def torch_trunc_normal_init(std, bound=2.0):
+    """``torch.nn.init.trunc_normal_(std=std)``: N(0, std²) truncated at
+    ABSOLUTE ±bound (so ±bound/std sigmas — effectively untruncated for
+    the std ≈ 0.02 used by ViT/Swin/ConvNeXt). jax's
+    ``initializers.truncated_normal`` instead truncates at ±2σ without
+    renormalizing (actual std ≈ 0.88·std), so it does NOT match."""
+
+    def init(key, shape, dtype=jnp.float32):
+        cut = bound / std
+        return std * jax.random.truncated_normal(key, -cut, cut, shape, dtype)
+
+    return init
+
+
 def uniform_bound_init(bound):
     """U(±bound) initializer (torchvision's Linear init for EfficientNet
     and others uses U(±1/sqrt(out_features)))."""

@@ -248,6 +248,50 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
              "dw": f"block.{d}.0", "dw_bn": f"block.{d}.1",
              "project": f"block.{d + 2}.0", "project_bn": f"block.{d + 2}.1"}
         return f"features.{si + 1}.{bi}.{m[sub]}"
+    if arch.startswith("convnext"):
+        # torch: features.0 stem (conv, LayerNorm2d), stages at odd
+        # features indices with .block Sequential (dw conv 0, LN 2,
+        # Linears 3/5) + raw layer_scale, downsamples (LN, conv) at even
+        # indices, classifier (LN, Flatten, Linear)
+        flat = {"stem_conv": "features.0.0", "stem_norm": "features.0.1",
+                "head_norm": "classifier.0", "head": "classifier.2"}
+        if head in flat:
+            return flat[head]
+        if head.startswith("downsample"):
+            si = int(head[len("downsample"):head.index("_")])
+            return f"features.{2 * si}.{0 if head.endswith('_norm') else 1}"
+        si, bi = (int(v) for v in head[len("stage"):].split("_block"))
+        base = f"features.{2 * si + 1}.{bi}"
+        if len(mod) == 1:
+            return base + ".{}"  # raw layer_scale Parameter
+        m = {"dw": "block.0", "norm": "block.2",
+             "mlp_1": "block.3", "mlp_2": "block.5"}
+        return f"{base}.{m[mod[1]]}"
+    if arch.startswith("swin"):
+        # torch: features.0 patch embed (conv 0, Permute 1, LN 2),
+        # stages at odd indices (norm1/norm2, attn with qkv/proj Linears
+        # + raw relative_position_bias_table / logit_scale + cpb_mlp
+        # Sequential, mlp Linears at 0/3), PatchMerging at even indices,
+        # final norm + head
+        flat = {"patch_conv": "features.0.0", "patch_norm": "features.0.2",
+                "norm": "norm", "head": "head"}
+        if head in flat:
+            return flat[head]
+        if head.startswith("merge"):
+            si = int(head[len("merge"):])
+            return f"features.{2 * si + 2}.{mod[1]}"
+        si, bi = (int(v) for v in head[len("stage"):].split("_block"))
+        base = f"features.{2 * si + 1}.{bi}"
+        sub = mod[1]
+        if sub == "attn":
+            if len(mod) == 2:
+                return f"{base}.attn.{{}}"  # raw rpb table / logit_scale
+            m = {"qkv": "qkv", "proj": "proj",
+                 "cpb_mlp_1": "cpb_mlp.0", "cpb_mlp_2": "cpb_mlp.2"}
+            return f"{base}.attn.{m[mod[2]]}"
+        m = {"norm1": "norm1", "norm2": "norm2",
+             "mlp_1": "mlp.0", "mlp_2": "mlp.3"}
+        return f"{base}.{m[sub]}"
     if arch.startswith("regnet"):
         # torch: stem Conv2dNormActivation, trunk_output.block{s+1} stages
         # of blocks named "block{s+1}-{i}", BottleneckTransform under .f
@@ -294,6 +338,8 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
                     chw = _DENSE_CHW.get((arch.split("_bn")[0].rstrip("0123456789"), names[:-1])) \
                         or _DENSE_CHW.get((arch, names[:-1]))
                     kind = ("dense_chw", chw) if chw else "dense"
+            elif names[-1] == "layer_scale":
+                kind = "layer_scale"  # torch (C,1,1) <-> NHWC (C,)
             else:
                 kind = "direct"
             key = tmod.format(tleaf) if "{}" in tmod else f"{tmod}.{tleaf}"
@@ -328,6 +374,8 @@ def _from_torch(arr: np.ndarray, kind) -> np.ndarray:
         return np.transpose(
             arr.reshape(o, c, h, w), (2, 3, 1, 0)
         ).reshape(h * w * c, o)
+    if kind == "layer_scale":
+        return arr.reshape(-1)  # torch (C,1,1) -> NHWC (C,)
     return arr
 
 
@@ -343,6 +391,8 @@ def _to_torch(arr: np.ndarray, kind) -> np.ndarray:
         return np.transpose(
             arr.reshape(h, w, c, o), (3, 2, 0, 1)
         ).reshape(o, c * h * w)
+    if kind == "layer_scale":
+        return arr.reshape(-1, 1, 1)  # NHWC (C,) -> torch (C,1,1)
     return arr
 
 

@@ -1,0 +1,341 @@
+"""Swin Transformer v1 (t/s/b) + v2 (t/s/b), torchvision-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a swin_t``). Fresh Flax
+build of torchvision's ``swin_transformer.py``:
+
+* patch embed 4x4/4 conv + LayerNorm (eps 1e-5, swin's norm everywhere);
+* four stages of blocks; between stages PatchMerging concatenates each
+  2x2 neighborhood to 4C and reduces to 2C (v1 norms the 4C input, v2
+  norms the 2C output);
+* block: LN -> shifted-window attention -> stochastic depth -> residual;
+  LN -> MLP(4x, GELU) -> stochastic depth -> residual. Blocks alternate
+  shift 0 / window//2;
+* window attention pads H/W up to window multiples, zeroes the shift
+  when the window covers the padded axis, rolls, partitions windows with
+  a reshape/transpose, and masks cross-region pairs with -100 in shifted
+  windows. All of that is static trace-time Python — under jit it
+  compiles to rolls + one big batched matmul chain on the MXU;
+* v1 adds a learned (2w-1)^2 x heads relative-position-bias table; v2
+  replaces it with a log-spaced continuous-position MLP
+  (2 -> 512 -> heads, bias 16*sigmoid), L2-normalized q/k cosine
+  attention with a per-head clamped-exp ``logit_scale``;
+* head: final LN -> global average pool -> Linear.
+
+Init matches torchvision: every Linear trunc_normal(0.02) with zero
+bias (the SwinTransformer-level loop overrides the per-block MLP
+xavier init), patch conv torch-default, bias table trunc_normal(0.02).
+Param counts locked in tests/test_models.py (swin_t = 28,288,354).
+"""
+
+import math
+from functools import partial
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dptpu.models.layers import (
+    StochasticDepth,
+    torch_default_bias_init,
+    torch_default_kernel_init,
+    torch_trunc_normal_init,
+)
+from dptpu.models.registry import register_variants
+
+# name -> (embed, depths, heads, window, stochastic_depth_rate, v2)
+_VARIANTS = {
+    "t": (96, (2, 2, 6, 2), (3, 6, 12, 24), 7, 0.2, False),
+    "s": (96, (2, 2, 18, 2), (3, 6, 12, 24), 7, 0.3, False),
+    "b": (128, (2, 2, 18, 2), (4, 8, 16, 32), 7, 0.5, False),
+    "v2_t": (96, (2, 2, 6, 2), (3, 6, 12, 24), 8, 0.2, True),
+    "v2_s": (96, (2, 2, 18, 2), (3, 6, 12, 24), 8, 0.3, True),
+    "v2_b": (128, (2, 2, 18, 2), (4, 8, 16, 32), 8, 0.5, True),
+}
+
+_trunc02 = torch_trunc_normal_init(0.02)
+
+
+def _relative_position_index(ws: int) -> np.ndarray:
+    """(ws^2, ws^2) lookup into the (2ws-1)^2 relative-position table."""
+    coords = np.stack(
+        np.meshgrid(np.arange(ws), np.arange(ws), indexing="ij")
+    ).reshape(2, -1)
+    rel = (coords[:, :, None] - coords[:, None, :]).transpose(1, 2, 0)
+    rel += ws - 1
+    return rel[..., 0] * (2 * ws - 1) + rel[..., 1]
+
+
+def _coords_table(ws: int) -> np.ndarray:
+    """v2 log-spaced normalized coordinate table ((2ws-1)^2, 2)."""
+    r = np.arange(-(ws - 1), ws, dtype=np.float32)
+    table = np.stack(np.meshgrid(r, r, indexing="ij"), axis=-1)
+    table = table / (ws - 1) * 8.0
+    table = np.sign(table) * np.log2(np.abs(table) + 1.0) / 3.0
+    return table.reshape(-1, 2)
+
+
+def _shift_mask(hp: int, wp: int, ws: int, sh: int, sw: int) -> np.ndarray:
+    """Additive (-100 off-region) attention mask (nW, ws^2, ws^2) for
+    shifted windows — static, computed from trace-time shapes."""
+    img = np.zeros((hp, wp), np.int32)
+    hs = ((0, hp - ws), (hp - ws, hp - sh), (hp - sh, hp)) if sh else ((0, hp),)
+    wss = ((0, wp - ws), (wp - ws, wp - sw), (wp - sw, wp)) if sw else ((0, wp),)
+    region = 0
+    for h0, h1 in hs:
+        for w0, w1 in wss:
+            img[h0:h1, w0:w1] = region
+            region += 1
+    mw = img.reshape(hp // ws, ws, wp // ws, ws).transpose(0, 2, 1, 3)
+    mw = mw.reshape(-1, ws * ws)
+    return np.where(
+        mw[:, None, :] != mw[:, :, None], -100.0, 0.0
+    ).astype(np.float32)
+
+
+class _QKVDense(nn.Module):
+    """qkv projection whose K third of the bias is functionally zeroed —
+    torchvision's v2 attention clones ``qkv_bias`` and zeroes
+    ``[C:2C]`` on every forward, so that slice never contributes and
+    never receives gradient; the param itself stays in the checkpoint
+    layout (``attn.qkv.bias``)."""
+
+    features: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", _trunc02, (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), self.param_dtype
+        )
+        third = self.features // 3
+        mask = np.ones((self.features,), np.float32)
+        mask[third:2 * third] = 0.0
+        bias = bias * jnp.asarray(mask, bias.dtype)
+        return x.astype(self.dtype) @ kernel.astype(self.dtype) \
+            + bias.astype(self.dtype)
+
+
+class ShiftedWindowAttention(nn.Module):
+    heads: int
+    window: int
+    shift: int
+    v2: bool
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        ws, hd = self.window, c // self.heads
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_trunc02, bias_init=nn.initializers.zeros,
+        )
+        pad_h, pad_w = (ws - h % ws) % ws, (ws - w % ws) % ws
+        if pad_h or pad_w:
+            x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        hp, wp = h + pad_h, w + pad_w
+        sh = 0 if ws >= hp else self.shift
+        sw = 0 if ws >= wp else self.shift
+        if sh or sw:
+            x = jnp.roll(x, (-sh, -sw), axis=(1, 2))
+        nh, nw = hp // ws, wp // ws
+        xw = x.reshape(b, nh, ws, nw, ws, c).transpose(0, 1, 3, 2, 4, 5)
+        xw = xw.reshape(b * nh * nw, ws * ws, c)
+
+        if self.v2:
+            qkv = _QKVDense(
+                features=3 * c, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="qkv",
+            )(xw)
+        else:
+            qkv = dense(3 * c, name="qkv")(xw)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (xw.shape[0], ws * ws, self.heads, hd)
+        q = q.reshape(shape).transpose(0, 2, 1, 3)
+        k = k.reshape(shape).transpose(0, 2, 1, 3)
+        v = v.reshape(shape).transpose(0, 2, 1, 3)
+        if self.v2:
+            # cosine attention with per-head learned temperature
+            logit_scale = self.param(
+                "logit_scale",
+                nn.initializers.constant(math.log(10.0)),
+                (self.heads, 1, 1), jnp.float32,
+            )
+            q = q / jnp.maximum(
+                jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-12)
+            k = k / jnp.maximum(
+                jnp.linalg.norm(k, axis=-1, keepdims=True), 1e-12)
+            attn = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+            attn = attn * jnp.exp(
+                jnp.minimum(logit_scale, math.log(100.0))
+            ).astype(attn.dtype)
+        else:
+            attn = jnp.einsum("bhqd,bhkd->bhqk", q * hd ** -0.5, k)
+
+        idx = _relative_position_index(ws).reshape(-1)
+        if self.v2:
+            table = jnp.asarray(_coords_table(ws), self.dtype)
+            cpb = dense(512, name="cpb_mlp_1")(table)
+            cpb = dense(
+                self.heads, use_bias=False, name="cpb_mlp_2"
+            )(nn.relu(cpb))
+            bias = cpb.reshape(-1, self.heads)[idx]
+            bias = bias.reshape(ws * ws, ws * ws, self.heads)
+            bias = 16.0 * nn.sigmoid(bias)
+        else:
+            rpb = self.param(
+                "relative_position_bias_table", _trunc02,
+                ((2 * ws - 1) ** 2, self.heads), jnp.float32,
+            )
+            bias = rpb[idx].reshape(ws * ws, ws * ws, self.heads)
+        attn = attn + bias.transpose(2, 0, 1).astype(attn.dtype)[None]
+
+        if sh or sw:
+            mask = jnp.asarray(_shift_mask(hp, wp, ws, sh, sw))
+            attn = attn.reshape(b, nh * nw, self.heads, ws * ws, ws * ws)
+            attn = attn + mask[None, :, None].astype(attn.dtype)
+            attn = attn.reshape(-1, self.heads, ws * ws, ws * ws)
+        attn = nn.softmax(
+            attn.astype(jnp.float32), axis=-1
+        ).astype(x.dtype)
+        y = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        y = y.transpose(0, 2, 1, 3).reshape(b * nh * nw, ws * ws, c)
+        y = dense(c, name="proj")(y)
+
+        y = y.reshape(b, nh, nw, ws, ws, c).transpose(0, 1, 3, 2, 4, 5)
+        y = y.reshape(b, hp, wp, c)
+        if sh or sw:
+            y = jnp.roll(y, (sh, sw), axis=(1, 2))
+        return y[:, :h, :w, :]
+
+
+class SwinBlock(nn.Module):
+    heads: int
+    window: int
+    shift: int
+    sd_prob: float
+    v2: bool
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        ln = partial(
+            nn.LayerNorm, epsilon=1e-5, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        sd = StochasticDepth(self.sd_prob, deterministic=not train)
+        attn = ShiftedWindowAttention(
+            heads=self.heads, window=self.window, shift=self.shift,
+            v2=self.v2, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="attn",
+        )
+        # v2 is res-post-norm: the LN moves from the branch input to the
+        # branch output (torchvision SwinTransformerBlockV2)
+        if self.v2:
+            x = x + sd(ln(name="norm1")(attn(x)))
+        else:
+            x = x + sd(attn(ln(name="norm1")(x)))
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_trunc02, bias_init=nn.initializers.zeros,
+        )
+        c = x.shape[-1]
+
+        def mlp(y):
+            y = dense(4 * c, name="mlp_1")(y)
+            y = nn.gelu(y, approximate=False)
+            return dense(c, name="mlp_2")(y)
+
+        if self.v2:
+            return x + sd(ln(name="norm2")(mlp(x)))
+        return x + sd(mlp(ln(name="norm2")(x)))
+
+
+class PatchMerging(nn.Module):
+    v2: bool
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            x = jnp.pad(x, ((0, 0), (0, h % 2), (0, w % 2), (0, 0)))
+        x = jnp.concatenate(
+            [x[:, 0::2, 0::2], x[:, 1::2, 0::2],
+             x[:, 0::2, 1::2], x[:, 1::2, 1::2]], axis=-1
+        )
+        ln = partial(
+            nn.LayerNorm, epsilon=1e-5, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="norm",
+        )
+        reduction = nn.Dense(
+            2 * c, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, kernel_init=_trunc02,
+            name="reduction",
+        )
+        if self.v2:
+            return ln()(reduction(x))
+        return reduction(ln()(x))
+
+
+class SwinTransformer(nn.Module):
+    variant: str = "t"
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Any = None  # no BN; accepted for API uniformity
+    bn_dtype: Any = None  # likewise
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        embed, depths, heads, window, sd_rate, v2 = _VARIANTS[self.variant]
+        x = nn.Conv(
+            embed, (4, 4), strides=(4, 4), padding="VALID", use_bias=True,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=torch_default_kernel_init,
+            bias_init=torch_default_bias_init(3 * 4 * 4),
+            name="patch_conv",
+        )(x)
+        x = nn.LayerNorm(
+            epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="patch_norm",
+        )(x)
+        total = sum(depths)
+        block_id = 0
+        for si, (depth, nheads) in enumerate(zip(depths, heads)):
+            for bi in range(depth):
+                x = SwinBlock(
+                    heads=nheads, window=window,
+                    shift=0 if bi % 2 == 0 else window // 2,
+                    sd_prob=sd_rate * block_id / (total - 1.0),
+                    v2=v2, dtype=self.dtype, param_dtype=self.param_dtype,
+                    name=f"stage{si}_block{bi}",
+                )(x, train)
+                block_id += 1
+            if si < len(depths) - 1:
+                x = PatchMerging(
+                    v2=v2, dtype=self.dtype, param_dtype=self.param_dtype,
+                    name=f"merge{si}",
+                )(x)
+        x = nn.LayerNorm(
+            epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="norm",
+        )(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_trunc02, bias_init=nn.initializers.zeros,
+            name="head",
+        )(x)
+
+
+register_variants(SwinTransformer, "swin", _VARIANTS)

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from dptpu.models.layers import uniform_bound_init
+from dptpu.models.layers import torch_trunc_normal_init, uniform_bound_init
 from dptpu.models.registry import register_variants
 
 # name -> (patch, layers, heads, hidden, mlp)
@@ -159,9 +159,7 @@ class VisionTransformer(nn.Module):
         x = nn.Conv(
             hidden, (patch, patch), strides=(patch, patch), padding="VALID",
             use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
-            kernel_init=nn.initializers.truncated_normal(
-                math.sqrt(1.0 / fan_in)
-            ),
+            kernel_init=torch_trunc_normal_init(math.sqrt(1.0 / fan_in)),
             bias_init=nn.initializers.zeros,
             name="conv_proj",
         )(x)

@@ -75,6 +75,16 @@ TORCHVISION_PARAM_COUNTS = {
     "regnet_y_16gf": 83_590_140,
     "regnet_y_32gf": 145_046_770,
     "regnet_y_128gf": 644_812_894,
+    "swin_t": 28_288_354,
+    "swin_s": 49_606_258,
+    "swin_b": 87_768_224,
+    "swin_v2_t": 28_351_570,
+    "swin_v2_s": 49_737_442,
+    "swin_v2_b": 87_930_848,
+    "convnext_tiny": 28_589_128,
+    "convnext_small": 50_223_688,
+    "convnext_base": 88_591_464,
+    "convnext_large": 197_767_336,
     # ViT counts are image-size dependent (pos embedding); locked at 224
     "vit_b_16": 86_567_656,
     "vit_b_32": 88_224_232,
@@ -119,6 +129,7 @@ def test_param_counts_match_torchvision(name):
     ("wide_resnet50_2", 64), ("alexnet", 224), ("mobilenet_v3_small", 64),
     ("efficientnet_b0", 64), ("efficientnet_v2_s", 64),
     ("regnet_y_400mf", 64), ("regnet_x_400mf", 64), ("vit_b_32", 64),
+    ("convnext_tiny", 64), ("swin_t", 64), ("swin_v2_t", 64),
 ])
 def test_family_concrete_init_and_forward(name, image):
     """One CONCRETE init+forward per family not covered elsewhere:
@@ -129,6 +140,28 @@ def test_family_concrete_init_and_forward(name, image):
     out = m.apply(v, jnp.zeros((2, image, image, 3)), train=False)
     assert out.shape == (2, 5)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_swin_static_helpers():
+    from dptpu.models.swin import (
+        _coords_table,
+        _relative_position_index,
+        _shift_mask,
+    )
+
+    idx = _relative_position_index(7)
+    assert idx.shape == (49, 49) and idx.min() == 0 and idx.max() == 168
+    # every self-pair maps to the center of the (2w-1)^2 table
+    assert (np.diag(idx) == 6 * 13 + 6).all()
+    m = _shift_mask(21, 21, 7, 3, 3)
+    assert m.shape == (9, 49, 49)
+    assert (m[0] == 0).all()  # interior window: no masking
+    assert (m == np.transpose(m, (0, 2, 1))).all()  # pair symmetry
+    assert (m[-1] != 0).any()  # corner window crosses regions
+    t = _coords_table(8)
+    # torchvision normalizes to sign(x)*log2(|8x|+1)/3: max = log2(9)/3
+    assert t.shape == (225, 2)
+    np.testing.assert_allclose(np.abs(t).max(), np.log2(9.0) / 3, rtol=1e-6)
 
 
 def test_shufflenet_forward_and_channel_shuffle():

@@ -31,7 +31,8 @@ def _init_vars(arch, num_classes=10, image=None):
         image = (32 if arch.startswith(("resnet", "densenet", "mobilenet",
                                          "wide_resnet", "resnext",
                                          "shufflenet", "mnasnet",
-                                         "efficientnet", "regnet"))
+                                         "efficientnet", "regnet",
+                                         "convnext", "swin"))
                  else 224)
     model = create_model(arch, num_classes=num_classes)
     # key maps / fake state dicts / conversion templates only need SHAPES:
@@ -72,7 +73,8 @@ def _fake_torch_sd(arch, variables, rng):
                                   "mobilenet_v3_small", "googlenet",
                                   "efficientnet_b0", "efficientnet_v2_s",
                                   "regnet_y_400mf", "regnet_x_800mf",
-                                  "vit_b_32"])
+                                  "vit_b_32", "convnext_tiny",
+                                  "swin_t", "swin_v2_t"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
@@ -148,6 +150,37 @@ def test_key_map_matches_known_torchvision_names():
     # the fused in_proj is a raw Parameter: no ".weight"-suffixed variant
     assert "encoder.layers.encoder_layer_0.self_attention.in_proj.weight" \
         not in keys
+    _, v = _init_vars("convnext_tiny", image=32)
+    keys = torch_key_map("convnext_tiny", v)
+    for k in ("features.0.0.weight", "features.0.1.bias",
+              "features.1.0.block.0.weight",   # dw conv
+              "features.1.0.block.2.weight",   # LN
+              "features.1.0.block.3.weight",   # mlp Linear 1
+              "features.1.0.block.5.bias",     # mlp Linear 2
+              "features.1.0.layer_scale",      # raw Parameter
+              "features.2.0.weight",           # downsample LN
+              "features.2.1.weight",           # downsample conv
+              "features.7.2.layer_scale",
+              "classifier.0.weight", "classifier.2.weight"):
+        assert k in keys, k
+    assert keys["features.1.0.layer_scale"][2] == "layer_scale"
+    _, v = _init_vars("swin_t", image=32)
+    keys = torch_key_map("swin_t", v)
+    for k in ("features.0.0.weight", "features.0.2.weight",
+              "features.1.0.attn.qkv.weight",
+              "features.1.0.attn.relative_position_bias_table",
+              "features.1.1.norm2.bias", "features.1.1.mlp.0.weight",
+              "features.2.norm.weight", "features.2.reduction.weight",
+              "features.7.1.attn.proj.bias", "norm.weight", "head.weight"):
+        assert k in keys, k
+    _, v = _init_vars("swin_v2_t", image=32)
+    keys = torch_key_map("swin_v2_t", v)
+    for k in ("features.1.0.attn.logit_scale",
+              "features.1.0.attn.cpb_mlp.0.weight",
+              "features.1.0.attn.cpb_mlp.2.weight"):
+        assert k in keys, k
+    # v2 swaps the table for the cpb MLP
+    assert "features.1.0.attn.relative_position_bias_table" not in keys
 
 
 def test_convert_round_trip_resnet18():
