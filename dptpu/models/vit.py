@@ -17,22 +17,29 @@ Flax build of torchvision's ``vision_transformer.py``:
 * final LN, classify from the class token through a ZERO-initialized
   Linear head (torchvision zero-inits ``heads.head``).
 
-The attention is a plain scaled-dot-product in jnp — two einsums around
-a softmax — which XLA maps straight onto the MXU; the fused qkv keeps
-it one big matmul per layer. Param counts locked in
-tests/test_models.py (vit_b_16 at 224 = 86,567,656).
+Attention goes through ``dptpu.ops.sequence_parallel``: on one device
+it is the plain scaled-dot-product (two einsums around an f32 softmax,
+straight onto the MXU); with ``seq_axis_name`` set and the token axis
+sharded over that mesh axis under ``shard_map``, it runs as Ulysses
+all-to-all or ring attention (``seq_mode``). The embedding stage
+(class token prepend + pos-embedding add) indexes absolute positions,
+so shard the ENCODER: replicate up to the embedding output, then
+partition the token axis (and ``encoder/pos_embedding``'s axis 1) with
+the same spec — tests/test_sequence_parallel.py shows the pattern at
+encoder-layer level. Param counts locked in tests/test_models.py
+(vit_b_16 at 224 = 86,567,656).
 """
 
 import math
 from functools import partial
-from typing import Any
+from typing import Any, Optional
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from dptpu.models.layers import torch_trunc_normal_init, uniform_bound_init
 from dptpu.models.registry import register_variants
+from dptpu.ops.sequence_parallel import sequence_parallel_attention
 
 # name -> (patch, layers, heads, hidden, mlp)
 _VARIANTS = {
@@ -52,11 +59,20 @@ xavier_uniform = nn.initializers.xavier_uniform()
 class SelfAttention(nn.Module):
     """torch ``nn.MultiheadAttention`` semantics: fused qkv projection
     (xavier-uniform, zero bias), scaled dot-product, out projection
-    (torch Linear default init, zero bias)."""
+    (torch Linear default init, zero bias).
+
+    ``seq_axis_name`` turns on sequence/context parallelism: under a
+    ``shard_map`` whose in/out specs shard the token axis over that mesh
+    axis, attention runs as Ulysses all-to-all or ring attention
+    (``seq_mode``) — see dptpu/ops/sequence_parallel.py. Every other ViT
+    sublayer is position-wise, so the encoder layer works on sequence
+    shards unchanged."""
 
     heads: int
     dtype: Any
     param_dtype: Any
+    seq_axis_name: Optional[str] = None
+    seq_mode: str = "ulysses"
 
     @nn.compact
     def __call__(self, x):
@@ -71,10 +87,9 @@ class SelfAttention(nn.Module):
         )(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(t.shape[:-1] + (self.heads, hd))
-        q, k, v = split(q), split(k), split(v)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
-        attn = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
-        y = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        y = sequence_parallel_attention(
+            split(q), split(k), split(v), self.seq_axis_name, self.seq_mode
+        )
         y = y.reshape(y.shape[:-2] + (h,))
         return dense(
             h,
@@ -89,6 +104,8 @@ class EncoderLayer(nn.Module):
     mlp_dim: int
     dtype: Any
     param_dtype: Any
+    seq_axis_name: Optional[str] = None
+    seq_mode: str = "ulysses"
 
     @nn.compact
     def __call__(self, x):
@@ -105,6 +122,7 @@ class EncoderLayer(nn.Module):
         y = SelfAttention(
             heads=self.heads, dtype=self.dtype,
             param_dtype=self.param_dtype, name="self_attention",
+            seq_axis_name=self.seq_axis_name, seq_mode=self.seq_mode,
         )(y)
         x = x + y
         y = ln(name="ln_2")(x)
@@ -120,6 +138,8 @@ class Encoder(nn.Module):
     mlp_dim: int
     dtype: Any
     param_dtype: Any
+    seq_axis_name: Optional[str] = None
+    seq_mode: str = "ulysses"
 
     @nn.compact
     def __call__(self, x):
@@ -132,6 +152,7 @@ class Encoder(nn.Module):
             x = EncoderLayer(
                 heads=self.heads, mlp_dim=self.mlp_dim, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"encoder_layer_{i}",
+                seq_axis_name=self.seq_axis_name, seq_mode=self.seq_mode,
             )(x)
         return nn.LayerNorm(
             epsilon=1e-6, dtype=self.dtype, param_dtype=self.param_dtype,
@@ -146,6 +167,8 @@ class VisionTransformer(nn.Module):
     param_dtype: Any = jnp.float32
     bn_axis_name: Any = None  # no BN; accepted for API uniformity
     bn_dtype: Any = None  # likewise
+    seq_axis_name: Optional[str] = None  # sequence parallelism (see above)
+    seq_mode: str = "ulysses"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -173,6 +196,7 @@ class VisionTransformer(nn.Module):
         x = Encoder(
             layers=layers, heads=heads, mlp_dim=mlp, dtype=self.dtype,
             param_dtype=self.param_dtype, name="encoder",
+            seq_axis_name=self.seq_axis_name, seq_mode=self.seq_mode,
         )(x)
         return nn.Dense(
             self.num_classes,

@@ -5,6 +5,12 @@ from dptpu.ops.schedules import (
     warmup_step_decay_lr,
     scale_lr_linear,
 )
+from dptpu.ops.sequence_parallel import (
+    full_attention,
+    ring_attention,
+    sequence_parallel_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "cross_entropy_loss",
@@ -13,4 +19,8 @@ __all__ = [
     "step_decay_lr",
     "warmup_step_decay_lr",
     "scale_lr_linear",
+    "full_attention",
+    "ring_attention",
+    "sequence_parallel_attention",
+    "ulysses_attention",
 ]

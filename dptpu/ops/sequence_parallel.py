@@ -1,0 +1,140 @@
+"""Sequence/context-parallel attention: Ulysses all-to-all + ring attention.
+
+The reference has no sequence dimension at all (vision CNNs,
+SURVEY.md §5 "long-context: absent by construction"), but this framework
+ships attention families (ViT/Swin), and on TPU the idiomatic way to
+scale their sequence axis past one chip's HBM is sequence parallelism
+over a named mesh axis. Two standard schemes, both expressed as pure
+functions over per-device shards for use inside ``shard_map``:
+
+* **Ulysses** (all-to-all head scatter): each device holds a sequence
+  shard of q/k/v with ALL heads; one ``lax.all_to_all`` per tensor
+  re-shards to all-sequence/heads-split, plain attention runs locally,
+  and one reverse all-to-all restores sequence sharding. Exact — the
+  result is bitwise the unsharded attention (modulo reduction order).
+  Communication rides the ICI as 3+1 all-to-alls of the activation size;
+  requires ``heads % axis_size == 0``.
+
+* **Ring attention** (k/v rotation with online softmax): k/v shards hop
+  around the ring via ``lax.ppermute`` inside a ``lax.fori_loop`` while
+  each device accumulates its queries' attention with the
+  running-max/denominator (flash-attention style) update — the full
+  (s, s) score matrix never materializes and each step overlaps the
+  next permute with compute. Works for any head count; memory per chip
+  is O(s_local * d), enabling sequences that cannot fit on one chip.
+
+Scaled dot-product convention matches ``dptpu.models.vit.SelfAttention``
+(scale 1/sqrt(head_dim), f32 softmax). Equivalence against single-device
+attention is locked in tests/test_sequence_parallel.py on the fake
+8-device CPU mesh.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def full_attention(q, k, v):
+    """Reference scaled-dot-product attention.
+
+    q/k/v: (batch, seq, heads, head_dim) -> (batch, seq, heads, head_dim).
+    """
+    hd = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn.astype(q.dtype), v)
+
+
+def ulysses_attention(q, k, v, axis_name: str):
+    """All-to-all sequence-parallel attention (per-shard view).
+
+    Inputs are the LOCAL sequence shard (batch, seq/N, heads, head_dim)
+    on every device of ``axis_name`` (size N, ``heads % N == 0``).
+    Internally re-shards to (batch, seq, heads/N, head_dim), runs plain
+    attention, and re-shards back. Call under ``shard_map`` with the
+    sequence axis of q/k/v partitioned over ``axis_name``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    heads = q.shape[2]
+    if heads % n:
+        raise ValueError(
+            f"ulysses needs heads ({heads}) divisible by axis size ({n})"
+        )
+    # (b, s/N, h, d) -> (b, s, h/N, d): scatter heads, gather sequence
+    gather = lambda t: jax.lax.all_to_all(
+        t, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    out = full_attention(gather(q), gather(k), gather(v))
+    # (b, s, h/N, d) -> (b, s/N, h, d)
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ring_attention(q, k, v, axis_name: str):
+    """Ring sequence-parallel attention with online softmax (per-shard).
+
+    Inputs are the LOCAL sequence shard (batch, seq/N, heads, head_dim).
+    k/v rotate N-1 times around the ring; the local q block folds each
+    incoming k/v block into flash-style running statistics
+    (row max ``m``, denominator ``l``, weighted accumulator ``o``), so
+    peak memory is O(s_local^2) scores per step instead of O(s^2).
+    """
+    n = jax.lax.axis_size(axis_name)
+    hd = q.shape[-1]
+    scale = 1.0 / math.sqrt(hd)
+    qf = q.astype(jnp.float32) * scale
+
+    def block(carry, kv):
+        m, l, o = carry
+        kb, vb = kv
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)  # rescale of prior accumulator
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
+        )
+        return (m_new, l, o)
+
+    # accumulators derived from qf so shard_map types them as varying
+    # over the ring axis (plain constants would mismatch the loop carry)
+    zero = (qf * 0.0).sum(axis=-1).transpose(0, 2, 1)  # (b, h, s_local)
+    m0 = zero - jnp.inf
+    l0 = zero
+    o0 = qf.transpose(0, 2, 1, 3) * 0.0
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        m_l_o, kb, vb = carry
+        m_l_o = block(m_l_o, (kb, vb))
+        # rotate AFTER consuming so the last block needs no extra hop;
+        # lax.cond keeps the final-iteration permute out of the graph
+        kb, vb = jax.lax.cond(
+            i < n - 1,
+            lambda kv: jax.lax.ppermute(kv, axis_name, perm),
+            lambda kv: kv,
+            (kb, vb),
+        )
+        return (m_l_o, kb, vb)
+
+    (m, l, o), _, _ = jax.lax.fori_loop(0, n, step, ((m0, l0, o0), k, v))
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (b, s/N, h, d)
+
+
+def sequence_parallel_attention(
+    q, k, v, axis_name: Optional[str], mode: str = "ulysses"
+):
+    """Dispatch: plain attention when unsharded, else ulysses or ring."""
+    if axis_name is None:
+        return full_attention(q, k, v)
+    if mode == "ulysses":
+        return ulysses_attention(q, k, v, axis_name)
+    if mode == "ring":
+        return ring_attention(q, k, v, axis_name)
+    raise ValueError(f"unknown sequence-parallel mode {mode!r}")
