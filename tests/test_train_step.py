@@ -76,6 +76,34 @@ def test_single_device_loss_decreases():
     assert int(state.step) == 20
 
 
+@pytest.mark.parametrize("arch,image", [
+    ("efficientnet_b0", 32),   # SE + BN + stochastic depth (dropout rng)
+    ("convnext_tiny", 32),     # NO batch_stats collection + layer scale
+])
+def test_train_step_runs_zoo_arch(arch, image):
+    """The generic step must drive every zoo family: stochastic-depth
+    archs need the dropout rng plumbed, LayerNorm-only archs must work
+    with an empty batch_stats tree."""
+    from dptpu.models import create_model
+
+    model = create_model(arch, num_classes=10)
+    tx = make_optimizer(momentum=0.9, weight_decay=1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, image, image, 3)
+    )
+    step = make_train_step()
+    # the step donates its input state: snapshot params first
+    leaves0 = [np.asarray(x) for x in jax.tree_util.tree_leaves(state.params)]
+    state2, metrics = step(state, _batch(8, size=image))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state2.step) == 1
+    leaves1 = jax.tree_util.tree_leaves(state2.params)
+    assert any(
+        not np.allclose(a, np.asarray(b))
+        for a, b in zip(leaves0, leaves1)
+    )
+
+
 def test_ddp_step_matches_single_device():
     # The DDP invariant: shard_map over 8 replicas with pmean'd grads ==
     # one single-device step on the same global batch (BN caveat: TinyNet's
