@@ -11,6 +11,7 @@ from dptpu.models import densenet as _densenet  # noqa: F401
 from dptpu.models import efficientnet as _efficientnet  # noqa: F401
 from dptpu.models import googlenet as _googlenet  # noqa: F401
 from dptpu.models import inception as _inception  # noqa: F401
+from dptpu.models import maxvit as _maxvit  # noqa: F401
 from dptpu.models import mnasnet as _mnasnet  # noqa: F401
 from dptpu.models import mobilenet as _mobilenet  # noqa: F401
 from dptpu.models import mobilenet_v3 as _mobilenet_v3  # noqa: F401

@@ -1,0 +1,246 @@
+"""MaxViT-T, torchvision-architecture-exact, NHWC.
+
+Registry-discoverable (imagenet_ddp.py:19-21, ``-a maxvit_t``). Fresh
+Flax build of torchvision's ``maxvit.py`` ("MaxViT: Multi-Axis Vision
+Transformer"):
+
+* stem: 3x3/2 conv BN GELU -> 3x3 conv (bias);
+* four blocks of MaxVit layers, each layer a fixed trio:
+  - **MBConv** (pre-norm BN -> 1x1 expand (4x) BN GELU -> 3x3/stride
+    depthwise BN GELU -> SiLU squeeze-excitation (0.25 of OUT channels)
+    -> 1x1 project with bias), shortcut = [3x3/2 avg pool ->] 1x1 conv;
+  - **window attention**: partition into 7x7 LOCAL windows, pre-LN
+    relative-position multi-head attention (head_dim 32) + MLP(4x GELU),
+    both residual with row-mode stochastic depth;
+  - **grid attention**: the dual axis — partition into a 7x7 GLOBAL
+    strided grid (window partition of size H/7, axes swapped) and run
+    the same attention over the sparse grid tokens;
+* classifier: global average pool -> LayerNorm -> Linear -> Tanh ->
+  Linear (no bias).
+
+Window/grid partitioning is trace-time reshape/transpose (the feature
+sizes 56/28/14/7 at 224 input are static), so XLA sees batched MXU
+matmuls; input H/W must be divisible by 7 after each stride-2 stage
+(224/448/... work). Stochastic depth ramps 0 -> 0.2 over all layers.
+Init: convs/Linears N(0, 0.02) with zero bias, BN 1/0, bias table
+trunc_normal(0.02) (torchvision's _init_weights). Param count locked in
+tests/test_models.py (30,919,624).
+"""
+
+import math
+from functools import partial
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dptpu.models.layers import SqueezeExcite, StochasticDepth
+from dptpu.models.registry import register_model
+from dptpu.models.swin import _relative_position_index, torch_trunc_normal_init
+
+# maxvit_t geometry
+_STEM = 64
+_CHANNELS = (64, 128, 256, 512)
+_LAYERS = (2, 2, 5, 2)
+_HEAD_DIM = 32
+_PARTITION = 7
+_SD_RATE = 0.2
+
+_normal02 = nn.initializers.normal(0.02)
+
+
+class MBConv(nn.Module):
+    out_ch: int
+    stride: int
+    sd_prob: float
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        in_ch = x.shape[-1]
+        mid = 4 * self.out_ch
+        sqz = self.out_ch // 4
+        shortcut = x
+        if self.stride != 1 or in_ch != self.out_ch:
+            if self.stride == 2:
+                # torch AvgPool2d(3, 2, 1) default: padded zeros COUNT in
+                # the divisor (count_include_pad=True)
+                shortcut = nn.avg_pool(
+                    shortcut, (3, 3), strides=(2, 2),
+                    padding=((1, 1), (1, 1)), count_include_pad=True,
+                )
+            shortcut = self.conv(
+                self.out_ch, (1, 1), use_bias=True, name="proj"
+            )(shortcut)
+        y = self.norm(name="pre_norm")(x)
+        y = self.conv(mid, (1, 1), name="conv_a")(y)
+        y = nn.gelu(self.norm(name="conv_a_bn")(y), approximate=False)
+        y = self.conv(
+            mid, (3, 3), strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)), feature_group_count=mid, name="conv_b",
+        )(y)
+        y = nn.gelu(self.norm(name="conv_b_bn")(y), approximate=False)
+        y = SqueezeExcite(
+            reduced=sqz, conv=self.conv, act=nn.silu, gate=nn.sigmoid,
+            name="se",
+        )(y)
+        y = self.conv(self.out_ch, (1, 1), use_bias=True, name="conv_c")(y)
+        y = StochasticDepth(self.sd_prob, deterministic=not train)(y)
+        return (shortcut + y).astype(y.dtype)
+
+
+class RelPosAttention(nn.Module):
+    """Pre-LN relative-position MHA + MLP over partitioned tokens
+    (x: (batch, n_partitions, seq, C))."""
+
+    head_dim: int
+    partition: int
+    sd_prob: float
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = x.shape[-1]
+        heads = c // self.head_dim
+        seq = self.partition * self.partition
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_normal02, bias_init=nn.initializers.zeros,
+        )
+        ln = partial(
+            nn.LayerNorm, epsilon=1e-5, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        sd = StochasticDepth(self.sd_prob, deterministic=not train)
+
+        y = ln(name="attn_norm")(x)
+        qkv = dense(3 * c, name="to_qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = q.shape[:-1] + (heads, self.head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        # torchvision quirk: scale_factor = feat_dim**-0.5 (the FULL
+        # channel dim, not head_dim) — pretrained weights expect it
+        attn = jnp.einsum("bpqhd,bpkhd->bphqk", q * c ** -0.5, k)
+        rpb = self.param(
+            "relative_position_bias_table", torch_trunc_normal_init(0.02),
+            ((2 * self.partition - 1) ** 2, heads), jnp.float32,
+        )
+        idx = _relative_position_index(self.partition).reshape(-1)
+        bias = rpb[idx].reshape(seq, seq, heads).transpose(2, 0, 1)
+        attn = attn + bias.astype(attn.dtype)[None, None]
+        attn = nn.softmax(attn.astype(jnp.float32), axis=-1).astype(x.dtype)
+        y = jnp.einsum("bphqk,bpkhd->bpqhd", attn, v)
+        y = y.reshape(y.shape[:-2] + (c,))
+        y = dense(c, name="merge")(y)
+        x = x + sd(y)
+
+        y = ln(name="mlp_norm")(x)
+        y = dense(4 * c, name="mlp_1")(y)
+        y = nn.gelu(y, approximate=False)
+        y = dense(c, name="mlp_2")(y)
+        return x + sd(y)
+
+
+class MaxVitLayer(nn.Module):
+    out_ch: int
+    stride: int
+    sd_prob: float
+    conv: Any
+    norm: Any
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        p = _PARTITION
+        x = MBConv(
+            out_ch=self.out_ch, stride=self.stride, sd_prob=self.sd_prob,
+            conv=self.conv, norm=self.norm, name="mbconv",
+        )(x, train)
+        b, h, w, c = x.shape
+        if h != w or h % p:
+            raise ValueError(
+                f"maxvit needs square feature sizes divisible by {p}; got "
+                f"{h}x{w} (input 224/448/... works)"
+            )
+        attn = partial(
+            RelPosAttention, head_dim=_HEAD_DIM, partition=p,
+            sd_prob=self.sd_prob, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        # window attention: local p x p tiles
+        y = x.reshape(b, h // p, p, w // p, p, c).transpose(0, 1, 3, 2, 4, 5)
+        y = y.reshape(b, (h // p) * (w // p), p * p, c)
+        y = attn(name="window_attn")(y, train)
+        y = y.reshape(b, h // p, w // p, p, p, c).transpose(0, 1, 3, 2, 4, 5)
+        x = y.reshape(b, h, w, c)
+        # grid attention: p x p global strided grid (partition by the
+        # complementary size g, then swap partition/token axes)
+        g = h // p
+        y = x.reshape(b, p, g, w // g, g, c).transpose(0, 1, 3, 2, 4, 5)
+        y = y.reshape(b, p * (w // g), g * g, c)
+        y = y.transpose(0, 2, 1, 3)  # tokens = the p*p strided positions
+        y = attn(name="grid_attn")(y, train)
+        y = y.transpose(0, 2, 1, 3)
+        y = y.reshape(b, p, w // g, g, g, c).transpose(0, 1, 3, 2, 4, 5)
+        return y.reshape(b, h, w, c)
+
+
+class MaxVit(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    bn_axis_name: Any = None
+    bn_dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, kernel_init=_normal02,
+            bias_init=nn.initializers.zeros,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.99, epsilon=1e-3,  # torch BN(eps 1e-3, momentum .01)
+            dtype=self.bn_dtype if self.bn_dtype is not None else self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        x = conv(_STEM, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                 name="stem_conv")(x)
+        x = nn.gelu(norm(name="stem_bn")(x), approximate=False)
+        x = conv(_STEM, (3, 3), padding=((1, 1), (1, 1)), use_bias=True,
+                 name="stem_conv2")(x)
+        total = sum(_LAYERS)
+        idx = 0
+        for bi, (ch, depth) in enumerate(zip(_CHANNELS, _LAYERS)):
+            for li in range(depth):
+                # torchvision ramps 0 -> sd_rate over the flat layer list
+                x = MaxVitLayer(
+                    out_ch=ch, stride=2 if li == 0 else 1,
+                    sd_prob=_SD_RATE * idx / (total - 1.0),
+                    conv=conv, norm=norm, dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    name=f"block{bi}_layer{li}",
+                )(x, train)
+                idx += 1
+        x = x.mean(axis=(1, 2))
+        dense = partial(
+            nn.Dense, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=_normal02, bias_init=nn.initializers.zeros,
+        )
+        x = nn.LayerNorm(
+            epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="head_norm",
+        )(x)
+        x = jnp.tanh(dense(_CHANNELS[-1], name="pre_head")(x))
+        return dense(self.num_classes, use_bias=False, name="head")(x)
+
+
+@register_model
+def maxvit_t(**kw):
+    return MaxVit(**kw)

@@ -292,6 +292,39 @@ def _torch_module(arch: str, mod: Tuple[str, ...]) -> str:
         m = {"norm1": "norm1", "norm2": "norm2",
              "mlp_1": "mlp.0", "mlp_2": "mlp.3"}
         return f"{base}.{m[sub]}"
+    if arch == "maxvit_t":
+        # torch: stem (two Conv2dNormActivations), blocks.{b}.layers.{l}
+        # .layers with MBconv (nested .layers OrderedDict + .proj
+        # shortcut) / window_attention / grid_attention (attn_layer 0=LN
+        # 1=RelativePositionalMultiHeadAttention, mlp_layer Sequential),
+        # classifier (pool, flatten, LN, Linear, Tanh, Linear)
+        flat = {"stem_conv": "stem.0.0", "stem_bn": "stem.0.1",
+                "stem_conv2": "stem.1.0", "head_norm": "classifier.2",
+                "pre_head": "classifier.3", "head": "classifier.5"}
+        if head in flat:
+            return flat[head]
+        b, l = head[len("block"):].split("_layer")
+        base = f"blocks.{b}.layers.{l}.layers"
+        sub = mod[1]
+        if sub == "mbconv":
+            mb = f"{base}.MBconv"
+            if mod[2] == "proj":
+                return f"{mb}.proj.1"  # avg-pool at proj.0 (stride 2)
+            if mod[2] == "se":
+                return f"{mb}.layers.squeeze_excitation.{mod[3]}"
+            m = {"pre_norm": "layers.pre_norm",
+                 "conv_a": "layers.conv_a.0", "conv_a_bn": "layers.conv_a.1",
+                 "conv_b": "layers.conv_b.0", "conv_b_bn": "layers.conv_b.1",
+                 "conv_c": "layers.conv_c"}
+            return f"{mb}.{m[mod[2]]}"
+        part = {"window_attn": "window_attention",
+                "grid_attn": "grid_attention"}[sub]
+        if len(mod) == 2:
+            return f"{base}.{part}.attn_layer.1.{{}}"  # raw rpb table
+        m = {"attn_norm": "attn_layer.0", "to_qkv": "attn_layer.1.to_qkv",
+             "merge": "attn_layer.1.merge", "mlp_norm": "mlp_layer.0",
+             "mlp_1": "mlp_layer.1", "mlp_2": "mlp_layer.3"}
+        return f"{base}.{part}.{m[mod[2]]}"
     if arch.startswith("regnet"):
         # torch: stem Conv2dNormActivation, trunk_output.block{s+1} stages
         # of blocks named "block{s+1}-{i}", BottleneckTransform under .f

@@ -75,6 +75,7 @@ TORCHVISION_PARAM_COUNTS = {
     "regnet_y_16gf": 83_590_140,
     "regnet_y_32gf": 145_046_770,
     "regnet_y_128gf": 644_812_894,
+    "maxvit_t": 30_919_624,  # image-size independent, needs 224-style grid
     "swin_t": 28_288_354,
     "swin_s": 49_606_258,
     "swin_b": 87_768_224,
@@ -119,7 +120,8 @@ def _param_count(name, image=64):
 
 @pytest.mark.parametrize("name", sorted(TORCHVISION_PARAM_COUNTS))
 def test_param_counts_match_torchvision(name):
-    image = (224 if name.startswith(("alexnet", "vgg", "squeezenet", "vit"))
+    image = (224 if name.startswith(("alexnet", "vgg", "squeezenet", "vit",
+                                     "maxvit"))
              else 64)
     assert _param_count(name, image) == TORCHVISION_PARAM_COUNTS[name]
 
@@ -139,6 +141,22 @@ def test_family_concrete_init_and_forward(name, image):
     v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
     out = m.apply(v, jnp.zeros((2, image, image, 3)), train=False)
     assert out.shape == (2, 5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_maxvit_rejects_bad_grid():
+    m = create_model("maxvit_t", num_classes=3)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.eval_shape(
+            m.init, jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3))
+        )
+
+
+def test_maxvit_forward():
+    m = create_model("maxvit_t", num_classes=3)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
+    out = m.apply(v, jnp.ones((1, 224, 224, 3)), train=False)
+    assert out.shape == (1, 3)
     assert np.isfinite(np.asarray(out)).all()
 
 

@@ -74,7 +74,7 @@ def _fake_torch_sd(arch, variables, rng):
                                   "efficientnet_b0", "efficientnet_v2_s",
                                   "regnet_y_400mf", "regnet_x_800mf",
                                   "vit_b_32", "convnext_tiny",
-                                  "swin_t", "swin_v2_t"])
+                                  "swin_t", "swin_v2_t", "maxvit_t"])
 def test_key_map_unique_and_torch_shaped(arch):
     _, v = _init_vars(arch)
     kmap = torch_key_map(arch, v)
@@ -181,6 +181,20 @@ def test_key_map_matches_known_torchvision_names():
         assert k in keys, k
     # v2 swaps the table for the cpb MLP
     assert "features.1.0.attn.relative_position_bias_table" not in keys
+    _, v = _init_vars("maxvit_t", image=224)
+    keys = torch_key_map("maxvit_t", v)
+    for k in ("stem.0.0.weight", "stem.1.0.bias",
+              "blocks.0.layers.0.layers.MBconv.proj.1.weight",
+              "blocks.0.layers.0.layers.MBconv.layers.pre_norm.running_var",
+              "blocks.0.layers.0.layers.MBconv.layers.conv_b.0.weight",
+              "blocks.0.layers.0.layers.MBconv.layers.squeeze_excitation.fc1.weight",
+              "blocks.0.layers.0.layers.window_attention.attn_layer.1.relative_position_bias_table",
+              "blocks.0.layers.0.layers.grid_attention.attn_layer.1.to_qkv.weight",
+              "blocks.3.layers.1.layers.grid_attention.mlp_layer.3.bias",
+              "classifier.2.weight", "classifier.3.bias",
+              "classifier.5.weight"):
+        assert k in keys, k
+    assert "classifier.5.bias" not in keys  # final head has no bias
 
 
 def test_convert_round_trip_resnet18():
