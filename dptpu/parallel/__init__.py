@@ -15,13 +15,23 @@ from dptpu.parallel.mesh import (
     replicated_sharding,
     shard_host_batch,
 )
+from dptpu.parallel.zero import (
+    gather_state,
+    make_zero1_train_step,
+    shard_zero1_state,
+    zero1_state_specs,
+)
 
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "data_sharding",
+    "gather_state",
     "initialize_distributed",
     "make_mesh",
+    "make_zero1_train_step",
     "replicated_sharding",
     "shard_host_batch",
+    "shard_zero1_state",
+    "zero1_state_specs",
 ]

@@ -1,0 +1,180 @@
+"""ZeRO-1 / cross-replica weight-update sharding over the data axis.
+
+The reference replicates optimizer state per process (SGD per rank,
+imagenet_ddp.py:133-135; SURVEY.md §2c lists sharded optimizers as an
+optional later optimization). On TPU the classic upgrade — Xu et al.'s
+weight-update sharding, the PAPERS.md retrieval — falls out of the same
+``shard_map`` step dptpu already uses for DDP:
+
+* params and optimizer state live SHARDED along the data axis (each
+  leaf split on dim 0 when divisible by the axis size, replicated
+  otherwise) — persistent per-chip memory for params + momentum drops
+  ~1/N;
+* inside the step each device ``all_gather``s the full params for
+  forward/backward. The VJP of a tiled all-gather is ``psum_scatter``,
+  so the gradient arrives REDUCE-SCATTERED — each device holds exactly
+  its shard's global-sum gradient. Total collective traffic
+  (all-gather + reduce-scatter) equals DDP's all-reduce; XLA overlaps
+  both with compute;
+* the SGD update (momentum, weight decay, LR) is elementwise, so each
+  device updates only its own shard — identical math to DDP, locked by
+  tests/test_zero1.py against the single-device big-batch step.
+
+Checkpointing/eval work unchanged: sharded arrays are still global
+jax.Arrays — ``np.asarray`` gathers for ``torch.save``-style
+serialization, and the replicated-spec eval step reshards on entry (use
+``gather_state`` once per validation pass to avoid re-gathering every
+eval step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:  # jax ≥ 0.8 top-level name; experimental path kept as fallback
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from dptpu.ops.loss import cross_entropy_loss
+from dptpu.ops.metrics import topk_correct_fraction
+from dptpu.parallel.mesh import DATA_AXIS
+from dptpu.train.step import normalize_images, tpu_compiler_options
+
+
+def _leaf_spec(leaf, n: int) -> P:
+    """Shard dim 0 over the data axis when it divides evenly."""
+    shape = getattr(leaf, "shape", ())
+    if len(shape) >= 1 and shape[0] >= n and shape[0] % n == 0:
+        return P(DATA_AXIS)
+    return P()
+
+
+def zero1_state_specs(state, mesh: Mesh):
+    """TrainState-shaped PartitionSpec tree: params/opt_state sharded on
+    dim 0 where divisible, everything else (step, batch_stats) replicated."""
+    n = int(mesh.shape[DATA_AXIS])
+    return state.replace(
+        step=P(),
+        params=jax.tree_util.tree_map(
+            lambda l: _leaf_spec(l, n), state.params),
+        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
+        opt_state=jax.tree_util.tree_map(
+            lambda l: _leaf_spec(l, n), state.opt_state),
+    )
+
+
+def shard_zero1_state(state, mesh: Mesh):
+    """Place a (replicated) TrainState into the ZeRO-1 layout: each
+    sharded leaf stores 1/N per device. Values are unchanged. NOTE:
+    ``device_put`` may alias the input's buffers — after sharding, step
+    only the returned state (the train steps donate their inputs)."""
+    specs = zero1_state_specs(state, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+
+
+def gather_state(state, mesh: Mesh):
+    """Re-replicate a ZeRO-1 state (e.g. once before a validation pass,
+    so the replicated-spec eval step doesn't all-gather every batch)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), state
+    )
+
+
+def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
+                          lr_schedule=None, seed: int = 0):
+    """ZeRO-1 variant of ``dptpu.train.step.make_train_step``.
+
+    ``state_template`` fixes which leaves shard; it must be the SAME
+    TrainState the returned step will receive (or share its
+    ``apply_fn``/``tx`` objects) — those static fields are part of the
+    pytree metadata that shard_map matches specs against. Returns
+    ``step(state, batch) -> (state, metrics)`` with the SAME contract and
+    math as the DDP step; ``state`` must be in the ``shard_zero1_state``
+    layout and comes back in it.
+    """
+    if lr_schedule is None:
+        lr_schedule = lambda count: 0.1  # noqa: E731
+    axis_size = int(mesh.shape[DATA_AXIS])
+    specs = zero1_state_specs(state_template, mesh)
+
+    def gather_params(params):
+        return jax.tree_util.tree_map(
+            lambda x, s: lax.all_gather(x, DATA_AXIS, axis=0, tiled=True)
+            if s == P(DATA_AXIS) else x,
+            params, specs.params,
+        )
+
+    def step(state, batch):
+        images = normalize_images(batch["images"], compute_dtype)
+        labels = batch["labels"]
+        dropout_key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+        dropout_key = jax.random.fold_in(
+            dropout_key, lax.axis_index(DATA_AXIS)
+        )
+
+        def loss_fn(local_params):
+            # all-gather -> full params; the VJP of the tiled all-gather
+            # is psum_scatter, so d(loss)/d(local_params) arrives already
+            # reduce-scattered: each device gets its shard of the global
+            # gradient sum with no separate all-reduce.
+            out, mutated = state.apply_fn(
+                {"params": gather_params(local_params),
+                 "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": dropout_key},
+            )
+            local_loss = cross_entropy_loss(out, labels)
+            # /axis_size turns the psum/psum_scatter of shard-local means
+            # into the global-batch mean (same reasoning as the DDP step)
+            return local_loss / axis_size, (
+                local_loss, out, mutated["batch_stats"])
+
+        (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
+        new_stats, loss, top1, top5 = lax.pmean(
+            (new_stats, loss, top1, top5), DATA_AXIS
+        )
+        # the optimizer chain is elementwise (momentum, wd, lr), so the
+        # shard-local update equals the corresponding slice of the full one
+        direction, new_opt = state.tx.update(
+            grads, state.opt_state, state.params)
+        lr = lr_schedule(state.step)
+        params = optax.apply_updates(
+            state.params,
+            jax.tree_util.tree_map(lambda u: -lr * u, direction),
+        )
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        metrics = {
+            "loss": loss,
+            "top1": top1 * 100.0,
+            "top5": top5 * 100.0,
+            "lr": jnp.asarray(lr, jnp.float32),
+        }
+        return new_state, metrics
+
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, P(DATA_AXIS)),
+        out_specs=(specs, P()),
+    )
+    return jax.jit(
+        sharded, donate_argnums=0, compiler_options=tpu_compiler_options()
+    )

@@ -39,7 +39,14 @@ from dptpu.ops.schedules import (
     make_step_decay_schedule,
     make_warmup_step_decay_schedule,
 )
-from dptpu.parallel import initialize_distributed, make_mesh, shard_host_batch
+from dptpu.parallel import (
+    gather_state,
+    initialize_distributed,
+    make_mesh,
+    make_zero1_train_step,
+    shard_host_batch,
+    shard_zero1_state,
+)
 from dptpu.train.checkpoint import load_checkpoint, save_checkpoint
 from dptpu.train.loop import train_one_epoch, validate
 from dptpu.train.state import create_train_state, make_optimizer
@@ -222,15 +229,35 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if verbose:
                 print(f"=> no checkpoint found at '{cfg.resume}'")
 
-    train_step = make_train_step(
-        mesh, compute_dtype, lr_schedule=schedule,
-        seed=cfg.seed if cfg.seed is not None else 0,
-    )
+    use_zero1 = _os_environ_flag("DPTPU_ZERO1") and mesh is not None
+    if use_zero1:
+        # ZeRO-1 weight-update sharding: params + momentum live sharded
+        # over the data axis (~1/N persistent memory per chip), gradients
+        # arrive reduce-scattered through the all-gather VJP; update math
+        # identical to DDP (tests/test_zero1.py). Checkpoints and eval
+        # read the state transparently (sharded leaves are global
+        # jax.Arrays); eval/checkpoint gathers are per-epoch, not per-step.
+        train_step = make_zero1_train_step(
+            mesh, state, compute_dtype, lr_schedule=schedule,
+            seed=cfg.seed if cfg.seed is not None else 0,
+        )
+        state = shard_zero1_state(state, mesh)
+        # one all-gather per validation pass / checkpoint write (instead
+        # of per eval step), and multi-host save stays fully addressable
+        eval_view = lambda s: gather_state(s, mesh)  # noqa: E731
+        if verbose:
+            print("=> ZeRO-1 optimizer-state sharding over the data axis")
+    else:
+        train_step = make_train_step(
+            mesh, compute_dtype, lr_schedule=schedule,
+            seed=cfg.seed if cfg.seed is not None else 0,
+        )
+        eval_view = lambda s: s  # noqa: E731
     eval_step = make_eval_step(mesh, compute_dtype)
 
     if cfg.evaluate:
         stats = validate(
-            state,
+            eval_view(state),
             eval_step,
             DevicePrefetcher(val_loader.epoch(0), put),
             num_batches=len(val_loader),
@@ -284,8 +311,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         if profile_dir and derived.is_chief and epoch == start_epoch:
             jax.profiler.stop_trace()
             profile_dir = None
+        gathered = eval_view(state)  # one ZeRO-1 all-gather per epoch
         val_stats = validate(
-            state,
+            gathered,
             eval_step,
             DevicePrefetcher(val_loader.epoch(0), put),
             num_batches=len(val_loader),
@@ -298,7 +326,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         best_acc1 = max(acc1, best_acc1)
         result["history"].append({"epoch": epoch, **{f"train_{k}": v for k, v in train_stats.items()}, **{f"val_{k}": v for k, v in val_stats.items()}})
         save_checkpoint(
-            state,
+            gathered,
             epoch=epoch + 1,
             arch=cfg.arch,
             best_acc1=best_acc1,
@@ -338,7 +366,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         if target_pct is not None and best_acc1 >= target_pct:
             training_time = time.time() - start_time
             save_checkpoint(
-                state,
+                gathered,
                 epoch=epoch + 1,
                 arch=cfg.arch,
                 best_acc1=best_acc1,

@@ -61,3 +61,35 @@ def test_fit_trains_checkpoints_and_early_stops(tiny_imagenet, tmp_path,
     assert eval_result["val"]["top1"] == pytest.approx(
         result["history"][-1]["val_top1"], abs=1e-6
     )
+
+
+def test_fit_zero1_matches_ddp(tiny_imagenet, tmp_path, monkeypatch):
+    """DPTPU_ZERO1=1 through the full fit() path must reproduce the DDP
+    run EPOCH FOR EPOCH (same seeded data order, same update math), while
+    checkpointing a gathered state that round-trips into a non-ZeRO eval
+    run."""
+    monkeypatch.chdir(tmp_path)
+    cfg = Config(
+        data=tiny_imagenet,
+        arch="resnet18",
+        epochs=2,
+        batch_size=24,
+        lr=0.02,
+        workers=2,
+        print_freq=1,
+        seed=1,
+    )
+    ddp = fit(cfg, image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_ZERO1", "1")
+    zero = fit(cfg, image_size=32, verbose=False)
+    assert os.path.exists("checkpoint.pth.tar")
+    for hd, hz in zip(ddp["history"], zero["history"]):
+        assert hz["train_loss"] == pytest.approx(hd["train_loss"], rel=1e-4)
+        assert hz["val_top1"] == pytest.approx(hd["val_top1"], abs=1e-6)
+
+    monkeypatch.delenv("DPTPU_ZERO1")
+    cfg_eval = cfg.replace(resume="checkpoint.pth.tar", evaluate=True)
+    eval_result = fit(cfg_eval, image_size=32, verbose=False)
+    assert eval_result["val"]["top1"] == pytest.approx(
+        zero["history"][-1]["val_top1"], abs=1e-6
+    )
