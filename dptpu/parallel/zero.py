@@ -29,11 +29,8 @@ eval step).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 try:  # jax ≥ 0.8 top-level name; experimental path kept as fallback
@@ -41,10 +38,12 @@ try:  # jax ≥ 0.8 top-level name; experimental path kept as fallback
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from dptpu.ops.loss import cross_entropy_loss
-from dptpu.ops.metrics import topk_correct_fraction
 from dptpu.parallel.mesh import DATA_AXIS
-from dptpu.train.step import normalize_images, tpu_compiler_options
+
+# NOTE: dptpu.train is imported lazily inside make_zero1_train_step —
+# a module-level import would close the cycle parallel/__init__ -> zero
+# -> train/__init__ -> fit -> parallel/__init__ (partially initialized)
+# whenever dptpu.parallel is imported before dptpu.train.
 
 
 def _leaf_spec(leaf, n: int) -> P:
@@ -100,12 +99,18 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
     math as the DDP step; ``state`` must be in the ``shard_zero1_state``
     layout and comes back in it.
     """
+    from dptpu.train.step import train_step_body, tpu_compiler_options
+
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
     axis_size = int(mesh.shape[DATA_AXIS])
     specs = zero1_state_specs(state_template, mesh)
 
     def gather_params(params):
+        # all-gather -> full params; the VJP of the tiled all-gather is
+        # psum_scatter, so the gradient w.r.t. the local shards arrives
+        # already reduce-scattered: each device gets its shard of the
+        # global gradient sum with no separate all-reduce.
         return jax.tree_util.tree_map(
             lambda x, s: lax.all_gather(x, DATA_AXIS, axis=0, tiled=True)
             if s == P(DATA_AXIS) else x,
@@ -113,61 +118,11 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
         )
 
     def step(state, batch):
-        images = normalize_images(batch["images"], compute_dtype)
-        labels = batch["labels"]
-        dropout_key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
-        dropout_key = jax.random.fold_in(
-            dropout_key, lax.axis_index(DATA_AXIS)
+        return train_step_body(
+            state, batch, compute_dtype=compute_dtype,
+            lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
+            on_mesh=True, gather_params=gather_params,
         )
-
-        def loss_fn(local_params):
-            # all-gather -> full params; the VJP of the tiled all-gather
-            # is psum_scatter, so d(loss)/d(local_params) arrives already
-            # reduce-scattered: each device gets its shard of the global
-            # gradient sum with no separate all-reduce.
-            out, mutated = state.apply_fn(
-                {"params": gather_params(local_params),
-                 "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": dropout_key},
-            )
-            local_loss = cross_entropy_loss(out, labels)
-            # /axis_size turns the psum/psum_scatter of shard-local means
-            # into the global-batch mean (same reasoning as the DDP step)
-            return local_loss / axis_size, (
-                local_loss, out, mutated["batch_stats"])
-
-        (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
-        new_stats, loss, top1, top5 = lax.pmean(
-            (new_stats, loss, top1, top5), DATA_AXIS
-        )
-        # the optimizer chain is elementwise (momentum, wd, lr), so the
-        # shard-local update equals the corresponding slice of the full one
-        direction, new_opt = state.tx.update(
-            grads, state.opt_state, state.params)
-        lr = lr_schedule(state.step)
-        params = optax.apply_updates(
-            state.params,
-            jax.tree_util.tree_map(lambda u: -lr * u, direction),
-        )
-        new_state = state.replace(
-            step=state.step + 1,
-            params=params,
-            batch_stats=new_stats,
-            opt_state=new_opt,
-        )
-        metrics = {
-            "loss": loss,
-            "top1": top1 * 100.0,
-            "top5": top5 * 100.0,
-            "lr": jnp.asarray(lr, jnp.float32),
-        }
-        return new_state, metrics
 
     sharded = shard_map(
         step,

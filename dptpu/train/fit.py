@@ -229,7 +229,13 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if verbose:
                 print(f"=> no checkpoint found at '{cfg.resume}'")
 
-    use_zero1 = _os_environ_flag("DPTPU_ZERO1") and mesh is not None
+    want_zero1 = _os_environ_flag("DPTPU_ZERO1")
+    # --evaluate never trains: sharding the state only to re-gather it
+    # for validation would be two pointless full-state device_put rounds
+    use_zero1 = want_zero1 and mesh is not None and not cfg.evaluate
+    if want_zero1 and mesh is None and verbose:
+        print("=> DPTPU_ZERO1 ignored: single-device run (no mesh to "
+              "shard the optimizer state over)")
     if use_zero1:
         # ZeRO-1 weight-update sharding: params + momentum live sharded
         # over the data axis (~1/N persistent memory per chip), gradients

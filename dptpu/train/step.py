@@ -82,6 +82,72 @@ def normalize_images(images, dtype=jnp.float32):
     return images.astype(dtype)
 
 
+def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
+                    axis_size, on_mesh, gather_params=None):
+    """The shared per-shard train-step math — ONE source of truth for the
+    DDP step below and the ZeRO-1 step (dptpu/parallel/zero.py), which
+    differ only in whether params pass through a ``gather_params`` hook
+    (whose all-gather VJP turns the gradient all-reduce into a
+    reduce-scatter) and in their shard_map specs."""
+    images = normalize_images(batch["images"], compute_dtype)
+    labels = batch["labels"]
+    dropout_key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+    if on_mesh:
+        dropout_key = jax.random.fold_in(
+            dropout_key, lax.axis_index(DATA_AXIS)
+        )
+
+    def loss_fn(params):
+        full = gather_params(params) if gather_params else params
+        out, mutated = state.apply_fn(
+            {"params": full, "batch_stats": state.batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": dropout_key},
+        )
+        local_loss = cross_entropy_loss(out, labels)
+        # Divide the shard-local mean by the axis size: under shard_map,
+        # replicated params enter invariant, and jax's VMA semantics make
+        # the gradient transpose insert the cross-shard psum automatically
+        # — that psum IS the DDP all-reduce (XLA schedules it overlapped
+        # with backward); psum(local_mean/axis_size) is exactly the
+        # global-batch-mean gradient. Through a gather_params hook the
+        # same transpose yields psum_scatter — the reduce-scattered shard
+        # of that gradient.
+        return local_loss / axis_size, (local_loss, out, mutated["batch_stats"])
+
+    (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(state.params)
+    top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
+    if on_mesh:
+        # running BN stats + reported metrics: explicit cross-replica mean
+        # (the reference's reduce_tensor, imagenet_ddp_apex.py:562-566)
+        new_stats, loss, top1, top5 = lax.pmean(
+            (new_stats, loss, top1, top5), DATA_AXIS
+        )
+    # the optimizer chain is elementwise (momentum, wd), so it is equally
+    # valid on full params (DDP) and on ZeRO-1 shard-local slices
+    direction, new_opt = state.tx.update(grads, state.opt_state, state.params)
+    lr = lr_schedule(state.step)
+    updates = jax.tree_util.tree_map(lambda u: -lr * u, direction)
+    params = optax.apply_updates(state.params, updates)
+    new_state = state.replace(
+        step=state.step + 1,
+        params=params,
+        batch_stats=new_stats,
+        opt_state=new_opt,
+    )
+    metrics = {
+        "loss": loss,
+        "top1": top1 * 100.0,
+        "top5": top5 * 100.0,
+        "lr": jnp.asarray(lr, jnp.float32),
+    }
+    return new_state, metrics
+
+
 def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
                     lr_schedule=None, seed: int = 0):
     """Build the jitted train step.
@@ -117,59 +183,11 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
     axis_size = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
 
     def step(state, batch):
-        images = normalize_images(batch["images"], compute_dtype)
-        labels = batch["labels"]
-        dropout_key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
-        if mesh is not None:
-            dropout_key = jax.random.fold_in(
-                dropout_key, lax.axis_index(DATA_AXIS)
-            )
-
-        def loss_fn(params):
-            out, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": dropout_key},
-            )
-            local_loss = cross_entropy_loss(out, labels)
-            # Divide the shard-local mean by the axis size: under shard_map,
-            # params enter replicated (in_spec P()), and jax's VMA semantics
-            # make the gradient transpose insert the cross-shard psum
-            # automatically — that psum IS the DDP all-reduce (XLA schedules
-            # it overlapped with backward). psum(local_mean/axis_size) is
-            # exactly the global-batch-mean gradient; an explicit pmean here
-            # would double-count by axis_size.
-            return local_loss / axis_size, (local_loss, out, mutated["batch_stats"])
-
-        (_, (loss, logits, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        top1, top5 = topk_correct_fraction(logits, labels, (1, 5))
-        if mesh is not None:
-            # running BN stats + reported metrics: explicit cross-replica mean
-            # (the reference's reduce_tensor, imagenet_ddp_apex.py:562-566)
-            new_stats, loss, top1, top5 = lax.pmean(
-                (new_stats, loss, top1, top5), DATA_AXIS
-            )
-        direction, new_opt = state.tx.update(grads, state.opt_state, state.params)
-        lr = lr_schedule(state.step)
-        updates = jax.tree_util.tree_map(lambda u: -lr * u, direction)
-        params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=params,
-            batch_stats=new_stats,
-            opt_state=new_opt,
+        return train_step_body(
+            state, batch, compute_dtype=compute_dtype,
+            lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
+            on_mesh=mesh is not None,
         )
-        metrics = {
-            "loss": loss,
-            "top1": top1 * 100.0,
-            "top5": top5 * 100.0,
-            "lr": jnp.asarray(lr, jnp.float32),
-        }
-        return new_state, metrics
 
     opts = tpu_compiler_options()
     if mesh is None:
