@@ -236,6 +236,8 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     if want_zero1 and mesh is None and verbose:
         print("=> DPTPU_ZERO1 ignored: single-device run (no mesh to "
               "shard the optimizer state over)")
+    elif want_zero1 and cfg.evaluate and verbose:
+        print("=> DPTPU_ZERO1 ignored: --evaluate does not train")
     if use_zero1:
         # ZeRO-1 weight-update sharding: params + momentum live sharded
         # over the data axis (~1/N persistent memory per chip), gradients

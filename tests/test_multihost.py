@@ -22,6 +22,11 @@ def _free_port():
         return s.getsockname()[1]
 
 
+# two subprocesses each compile the full step on CPU; under pytest-xdist
+# the host is oversubscribed by the other workers, so give them longer
+_TIMEOUT = 280 * (3 if os.environ.get("PYTEST_XDIST_WORKER") else 1)
+
+
 def test_two_process_training_agrees(tmp_path):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
@@ -44,7 +49,7 @@ def test_two_process_training_agrees(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=280)
+            out, _ = p.communicate(timeout=_TIMEOUT)
             outs.append(out)
     finally:
         for p in procs:
@@ -139,7 +144,7 @@ def test_two_process_full_fit_agrees(tmp_path):
         for rank in range(2)
     ]
     try:
-        outs = [p.communicate(timeout=280)[0] for p in procs]
+        outs = [p.communicate(timeout=_TIMEOUT)[0] for p in procs]
     finally:
         for p in procs:
             if p.poll() is None:
