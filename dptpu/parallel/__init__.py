@@ -15,6 +15,11 @@ from dptpu.parallel.mesh import (
     replicated_sharding,
     shard_host_batch,
 )
+from dptpu.parallel.gspmd import (
+    make_gspmd_train_step,
+    shard_gspmd_state,
+    vit_tp_specs,
+)
 from dptpu.parallel.zero import (
     gather_state,
     make_zero1_train_step,
@@ -28,10 +33,13 @@ __all__ = [
     "data_sharding",
     "gather_state",
     "initialize_distributed",
+    "make_gspmd_train_step",
     "make_mesh",
     "make_zero1_train_step",
     "replicated_sharding",
+    "shard_gspmd_state",
     "shard_host_batch",
     "shard_zero1_state",
+    "vit_tp_specs",
     "zero1_state_specs",
 ]

@@ -1,0 +1,130 @@
+"""GSPMD (pjit-style) train step: sharding annotations, XLA collectives.
+
+The DDP/ZeRO-1 steps express parallelism explicitly with ``shard_map``
+(per-shard code + hand-placed collectives). This module is the OTHER
+idiomatic TPU path — the scaling-book recipe: write single-program code,
+annotate the param/batch shardings on ``jit``, and let XLA's SPMD
+partitioner insert the all-reduces/all-gathers. Out of reference scope
+(the reference is pure DDP, SURVEY.md §2c) but it is what the open
+``model`` mesh axis exists for.
+
+Shipped sharding rule: **Megatron-style MLP tensor parallelism for
+ViT** (``vit_tp_specs``) — each encoder MLP's first Linear is
+column-parallel (kernel ``P(None, "model")``, bias ``P("model")``) and
+the second row-parallel (``P("model", None)``, replicated bias), so the
+two big matmuls per layer run on 1/M of the hidden dim per device and
+XLA inserts exactly one all-reduce per MLP. Attention params stay
+replicated (the fused qkv kernel's output axis crosses q/k/v boundaries
+when sliced naively; head-aligned attention TP is what
+``dptpu.ops.sequence_parallel`` + shard_map are for). Composes with
+data parallelism over the ``data`` axis of the same mesh: batch sharded
+``P("data")``, gradients all-reduced by the partitioner.
+
+Semantics note: under GSPMD the whole global batch is one logical
+program, so any BatchNorm computes GLOBAL batch statistics (SyncBN
+behavior); ViT/ConvNeXt (LayerNorm) are unaffected. Parity with the
+single-device step is locked in tests/test_gspmd.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dptpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# NOTE: dptpu.train imports stay lazy (same cycle as dptpu/parallel/zero.py).
+
+
+def vit_tp_specs(params):
+    """PartitionSpec tree for ViT: Megatron MLP tensor parallelism over
+    the ``model`` axis, everything else replicated."""
+
+    def spec(path, leaf):
+        names = [p.key for p in path]
+        mod = names[-2] if len(names) > 1 else ""
+        if mod == "mlp_1":  # column-parallel: split the 4h hidden dim
+            return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
+        if mod == "mlp_2":  # row-parallel: split the input dim
+            return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def state_shardings(state, mesh: Mesh, param_specs):
+    """TrainState of NamedShardings: params (and their momentum mirror in
+    opt_state) follow ``param_specs``; step/batch_stats replicated."""
+    pshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs
+    )
+    flat_p, _ = jax.tree_util.tree_flatten(pshard)
+    # the optimizer state mirrors the param tree leaf-for-leaf where
+    # shapes match (optax trace); anything else (counts etc.) replicates
+    p_by_shape = {}
+    for leaf, sh in zip(jax.tree_util.tree_leaves(state.params), flat_p):
+        p_by_shape.setdefault(tuple(leaf.shape), sh)
+    rep = NamedSharding(mesh, P())
+
+    def opt_shard(leaf):
+        return p_by_shape.get(tuple(leaf.shape), rep)
+
+    return state.replace(
+        step=rep,
+        params=pshard,
+        batch_stats=jax.tree_util.tree_map(lambda _: rep, state.batch_stats),
+        opt_state=jax.tree_util.tree_map(opt_shard, state.opt_state),
+    )
+
+
+def shard_gspmd_state(state, mesh: Mesh, param_specs):
+    """Place a TrainState according to ``state_shardings``. NOTE: may
+    alias the input's buffers — step only the returned state afterwards
+    (the step donates its input)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s),
+        state, state_shardings(state, mesh, param_specs),
+    )
+
+
+def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
+                          compute_dtype=jnp.float32, lr_schedule=None,
+                          seed: int = 0):
+    """Single-program train step partitioned by XLA.
+
+    Same contract as ``make_train_step``: ``step(state, batch) ->
+    (state, metrics)``; ``batch`` is the GLOBAL batch (sharded
+    ``P("data")`` on entry), metrics are global scalars. The gradient
+    all-reduce over ``data`` and the TP all-reduces over ``model`` are
+    inserted by the SPMD partitioner — there is no collective in this
+    source.
+    """
+    from dptpu.train.step import train_step_body, tpu_compiler_options
+
+    if lr_schedule is None:
+        lr_schedule = lambda count: 0.1  # noqa: E731
+
+    def step(state, batch):
+        # one logical program over the global batch: the shared step body
+        # with no shard-local scaling or explicit collectives — the SPMD
+        # partitioner derives all communication from the shardings
+        return train_step_body(
+            state, batch, compute_dtype=compute_dtype,
+            lr_schedule=lr_schedule, seed=seed, axis_size=1, on_mesh=False,
+        )
+
+    st_shardings = state_shardings(state_template, mesh, param_specs)
+    batch_shardings = {
+        "images": NamedSharding(mesh, P(DATA_AXIS)),
+        "labels": NamedSharding(mesh, P(DATA_AXIS)),
+    }
+    rep = NamedSharding(mesh, P())
+    metric_shardings = {k: rep for k in ("loss", "top1", "top5", "lr")}
+    return jax.jit(
+        step,
+        in_shardings=(st_shardings, batch_shardings),
+        out_shardings=(st_shardings, metric_shardings),
+        donate_argnums=0,
+        compiler_options=tpu_compiler_options(),
+    )
