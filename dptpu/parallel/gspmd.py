@@ -53,28 +53,38 @@ def vit_tp_specs(params):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def _opt_shardings(opt_state, pshard, rep):
+    """Momentum (optax ``TraceState``) mirrors the param tree exactly, so
+    it takes the param shardings STRUCTURALLY (matching by shape alone
+    would misplace a replicated param whose shape collides with a
+    TP-sharded one); every other optimizer leaf replicates."""
+    import optax
+
+    def rec(node):
+        if isinstance(node, optax.TraceState):
+            return optax.TraceState(trace=pshard)
+        if isinstance(node, (tuple, list)) and not hasattr(node, "shape"):
+            children = [rec(c) for c in node]
+            if hasattr(node, "_fields"):  # NamedTuple (optax states)
+                return type(node)(*children)
+            return children if isinstance(node, list) else tuple(children)
+        return jax.tree_util.tree_map(lambda _: rep, node)
+
+    return rec(opt_state)
+
+
 def state_shardings(state, mesh: Mesh, param_specs):
     """TrainState of NamedShardings: params (and their momentum mirror in
     opt_state) follow ``param_specs``; step/batch_stats replicated."""
     pshard = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), param_specs
     )
-    flat_p, _ = jax.tree_util.tree_flatten(pshard)
-    # the optimizer state mirrors the param tree leaf-for-leaf where
-    # shapes match (optax trace); anything else (counts etc.) replicates
-    p_by_shape = {}
-    for leaf, sh in zip(jax.tree_util.tree_leaves(state.params), flat_p):
-        p_by_shape.setdefault(tuple(leaf.shape), sh)
     rep = NamedSharding(mesh, P())
-
-    def opt_shard(leaf):
-        return p_by_shape.get(tuple(leaf.shape), rep)
-
     return state.replace(
         step=rep,
         params=pshard,
         batch_stats=jax.tree_util.tree_map(lambda _: rep, state.batch_stats),
-        opt_state=jax.tree_util.tree_map(opt_shard, state.opt_state),
+        opt_state=_opt_shardings(state.opt_state, pshard, rep),
     )
 
 

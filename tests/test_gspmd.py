@@ -92,3 +92,33 @@ def test_gspmd_state_physically_sharded(eight_devices):
             mom = leaf
             break
     assert mom is not None and mom.sharding.spec == P(None, "model")
+
+
+def test_opt_shardings_are_structural_not_shape_keyed(eight_devices):
+    """A replicated param whose SHAPE collides with a TP-sharded MLP leaf
+    (head kernel when num_classes == mlp hidden) must keep a replicated
+    momentum — the trace is matched by tree position, not by shape."""
+    from dptpu.parallel.gspmd import state_shardings
+
+    mesh = make_mesh(eight_devices, {"data": 2, "model": 4})
+    model = create_model("vit_b_32", num_classes=3072)
+    tx = make_optimizer(momentum=0.9, weight_decay=1e-4)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 64, 64, 3)
+    )
+    sh = state_shardings(state, mesh, vit_tp_specs(state.params))
+    assert sh.params["head"]["kernel"].spec == P()
+    # find the momentum sharding at the head kernel's tree position: it
+    # must be replicated even though its shape equals mlp_1's kernel
+    import optax
+
+    for node in jax.tree_util.tree_leaves(
+        sh.opt_state, is_leaf=lambda n: isinstance(n, optax.TraceState)
+    ):
+        if isinstance(node, optax.TraceState):
+            assert node.trace["head"]["kernel"].spec == P()
+            assert node.trace["encoder"]["encoder_layer_0"]["mlp_1"][
+                "kernel"].spec == P(None, "model")
+            break
+    else:  # pragma: no cover
+        raise AssertionError("no TraceState found in opt_state shardings")

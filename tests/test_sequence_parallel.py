@@ -104,6 +104,35 @@ def test_registry_accepts_seq_kwargs():
     assert m.seq_axis_name == "seq" and m.seq_mode == "ring"
 
 
+@pytest.mark.parametrize("mode", ["ulysses", "ring"])
+def test_vit_full_encoder_sequence_parallel(eight_devices, mode):
+    """The README recipe at full-Encoder scope: params replicated EXCEPT
+    pos_embedding, whose token axis shards with the activations. Both
+    modes must reproduce the unsharded Encoder."""
+    from dptpu.models.vit import Encoder
+
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 64, 96))
+    kw = dict(layers=2, heads=8, mlp_dim=192, dtype=jnp.float32,
+              param_dtype=jnp.float32)
+    enc = Encoder(**kw)
+    params = enc.init(jax.random.PRNGKey(7), x)
+    want = enc.apply(params, x)
+
+    sp = Encoder(**kw, seq_axis_name="seq", seq_mode=mode)
+    pspecs = jax.tree_util.tree_map(lambda _: P(), params)
+    pspecs["params"]["pos_embedding"] = P(None, "seq", None)
+    fn = shard_map(
+        lambda p, t: sp.apply(p, t),
+        mesh=_mesh(eight_devices),
+        in_specs=(pspecs, P(None, "seq", None)),
+        out_specs=P(None, "seq", None),
+        check_rep=False,
+    )
+    got = jax.jit(fn)(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-5, rtol=5e-5)
+
+
 def test_vit_encoder_layer_sequence_parallel(eight_devices):
     """A full ViT encoder layer (LN + attention + MLP) under shard_map
     with the token axis sharded reproduces the unsharded layer: every
