@@ -364,7 +364,16 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
                 tleaf = _LEAF_TO_TORCH.get(names[-1], names[-1])
             else:
                 tleaf = _LEAF_TO_TORCH[names[-1]]
-            if names[-1] == "kernel":
+            if arch.startswith("vit_") and len(names) >= 2 \
+                    and names[-2] == "in_proj":
+                # fused qkv: torch stores [q|k|v]-major, dptpu stores
+                # head-major (dptpu/models/vit.py SelfAttention) — the
+                # converter permutes in addition to the OI->IO transpose
+                from dptpu.models.vit import _VARIANTS
+
+                heads = _VARIANTS[arch[len("vit_"):]][2]
+                kind = ("vit_qkv", heads, names[-1])
+            elif names[-1] == "kernel":
                 if leaf.ndim == 4:
                     kind = "conv"
                 else:
@@ -409,6 +418,11 @@ def _from_torch(arr: np.ndarray, kind) -> np.ndarray:
         ).reshape(h * w * c, o)
     if kind == "layer_scale":
         return arr.reshape(-1)  # torch (C,1,1) -> NHWC (C,)
+    if isinstance(kind, tuple) and kind[0] == "vit_qkv":
+        _, heads, leaf = kind
+        if leaf == "kernel":
+            arr = np.transpose(arr, (1, 0))  # (3h, h) -> (h, 3h) [q|k|v]
+        return qkv_permute(arr, heads, to_head_major=True)
     return arr
 
 
@@ -426,6 +440,12 @@ def _to_torch(arr: np.ndarray, kind) -> np.ndarray:
         ).reshape(o, c * h * w)
     if kind == "layer_scale":
         return arr.reshape(-1, 1, 1)  # NHWC (C,) -> torch (C,1,1)
+    if isinstance(kind, tuple) and kind[0] == "vit_qkv":
+        _, heads, leaf = kind
+        arr = qkv_permute(arr, heads, to_head_major=False)
+        if leaf == "kernel":
+            return np.transpose(arr, (1, 0))
+        return arr
     return arr
 
 
@@ -475,8 +495,36 @@ def convert_state_dict(arch: str, state_dict: Dict[str, np.ndarray],
 # npz round trip + runtime resolution
 # ---------------------------------------------------------------------------
 
+# Layout versioning: ViT fused-qkv columns are stored HEAD-MAJOR since
+# round 4 (dptpu/models/vit.py SelfAttention). npz files record the
+# layout under a ``__meta__/`` key; unmarked ViT files predate the change
+# (they are [q|k|v]-major) and are migrated on load. Same shapes either
+# way, so this marker is the ONLY way to tell them apart.
+QKV_LAYOUT = "head_major"
+
+
+def qkv_permute(arr: np.ndarray, heads: int, *, to_head_major: bool):
+    """The ONE definition of the qkv column permutation, used by the
+    torch converters and the legacy-layout migrations alike.
+
+    The fused projection's output axis (size 3h) factors as
+    ``(3, heads, hd)`` in [q|k|v]-major order and ``(heads, 3, hd)`` in
+    head-major order; this swaps the two leading factors in whichever
+    direction is asked. Works on the kernel's last axis (h, 3h) and the
+    bias (3h,)."""
+    lead = arr.shape[:-1]
+    n3h = arr.shape[-1]
+    h = n3h // 3
+    a, b = ((3, heads) if to_head_major else (heads, 3))
+    ndim = len(lead)
+    perm = tuple(range(ndim)) + (ndim + 1, ndim, ndim + 2)
+    return arr.reshape(lead + (a, b, h // heads)).transpose(perm).reshape(
+        lead + (n3h,)
+    )
+
+
 def save_npz(path: str, variables) -> None:
-    flat = {}
+    flat = {"__meta__/qkv_layout": np.asarray(QKV_LAYOUT)}
     for collection in ("params", "batch_stats"):
         for p, leaf in jax.tree_util.tree_flatten_with_path(
                 variables.get(collection, {}))[0]:
@@ -490,11 +538,44 @@ def load_npz(path: str):
     with np.load(path) as data:
         for key in data.files:
             collection, *names = key.split("/")
+            if collection == "__meta__":
+                continue  # layout markers — read via npz_meta
             tree = out[collection]
             for n in names[:-1]:
                 tree = tree.setdefault(n, {})
             tree[names[-1]] = data[key]
     return out
+
+
+def npz_meta(path: str) -> Dict[str, str]:
+    """The ``__meta__/*`` markers of a converted-weights file (empty for
+    files written before markers existed)."""
+    out = {}
+    with np.load(path) as data:
+        for key in data.files:
+            if key.startswith("__meta__/"):
+                out[key[len("__meta__/"):]] = str(data[key])
+    return out
+
+
+def _qkv_to_head_major(arch: str, variables):
+    """Migrate a [q|k|v]-major ViT tree (pre-round-4 conversion) to the
+    head-major storage layout. Works on any dict tree whose in_proj
+    leaves sit at ``…/in_proj/{kernel,bias}`` — the variables dict, a
+    bare params tree, or a momentum trace mirroring params."""
+    from dptpu.models.vit import _VARIANTS
+
+    heads = _VARIANTS[arch[len("vit_"):]][2]
+
+    def fix(path, leaf):
+        names = tuple(p.key for p in path)
+        if len(names) >= 2 and names[-2] == "in_proj":
+            return qkv_permute(
+                np.asarray(leaf), heads, to_head_major=True
+            )
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, variables)
 
 
 def weights_search_dirs():
@@ -535,6 +616,11 @@ def load_pretrained_variables(arch: str, model, input_shape=(1, 224, 224, 3)):
     """
     path = require_weights(arch)
     loaded = load_npz(path)
+    if arch.startswith("vit_") and \
+            npz_meta(path).get("qkv_layout") != QKV_LAYOUT:
+        # unmarked = converted before the head-major qkv storage layout:
+        # same shapes, permuted columns — migrate silently-correctly
+        loaded = _qkv_to_head_major(arch, loaded)
     template = model.init(
         jax.random.PRNGKey(0), np.zeros(input_shape, np.float32), train=False
     )

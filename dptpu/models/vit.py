@@ -61,6 +61,25 @@ class SelfAttention(nn.Module):
     (xavier-uniform, zero bias), scaled dot-product, out projection
     (torch Linear default init, zero bias).
 
+    The fused projection's output axis is stored **head-major**:
+    ``(head0: q,k,v)(head1: q,k,v)…`` — i.e. ``(h, heads, 3, hd)``
+    flattened — NOT torch's ``[q|k|v]`` concatenation. Random init is
+    layout-blind (iid columns) and the pretrained converter permutes
+    torch's ``in_proj_weight/bias`` into this order
+    (dptpu/models/pretrained.py, kind ``vit_qkv``). The payoff is
+    tensor parallelism: a plain contiguous ``P(None, "model")`` split of
+    the fused kernel is head-aligned for any mesh size dividing
+    ``heads``, so GSPMD head-group attention TP (dptpu/parallel/gspmd.py
+    ``vit_tp_specs``) needs no resharding — each device projects and
+    attends its own head group, and the row-parallel out projection's
+    psum is the block's single all-reduce.
+
+    Migration: converted ``.npz`` weights and flax checkpoints both
+    carry a ``qkv_layout`` marker now; unmarked (pre-round-4,
+    [q|k|v]-major) ViT files are auto-permuted on load — params AND the
+    momentum trace (``pretrained.load_pretrained_variables``,
+    ``train.checkpoint.load_checkpoint``).
+
     ``seq_axis_name`` turns on sequence/context parallelism: under a
     ``shard_map`` whose in/out specs shard the token axis over that mesh
     axis, attention runs as Ulysses all-to-all or ring attention
@@ -85,10 +104,11 @@ class SelfAttention(nn.Module):
             3 * h, kernel_init=xavier_uniform,
             bias_init=nn.initializers.zeros, name="in_proj",
         )(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        split = lambda t: t.reshape(t.shape[:-1] + (self.heads, hd))
+        # head-major layout (see class docstring): (…, heads, 3, hd)
+        qkv = qkv.reshape(qkv.shape[:-1] + (self.heads, 3, hd))
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         y = sequence_parallel_attention(
-            split(q), split(k), split(v), self.seq_axis_name, self.seq_mode
+            q, k, v, self.seq_axis_name, self.seq_mode
         )
         y = y.reshape(y.shape[:-2] + (h,))
         return dense(

@@ -24,6 +24,7 @@ from dptpu.parallel.zero import (
     gather_state,
     make_zero1_train_step,
     shard_zero1_state,
+    zero1_sharded_fraction,
     zero1_state_specs,
 )
 
@@ -41,5 +42,6 @@ __all__ = [
     "shard_host_batch",
     "shard_zero1_state",
     "vit_tp_specs",
+    "zero1_sharded_fraction",
     "zero1_state_specs",
 ]

@@ -8,17 +8,16 @@ partitioner insert the all-reduces/all-gathers. Out of reference scope
 (the reference is pure DDP, SURVEY.md §2c) but it is what the open
 ``model`` mesh axis exists for.
 
-Shipped sharding rule: **Megatron-style MLP tensor parallelism for
-ViT** (``vit_tp_specs``) — each encoder MLP's first Linear is
-column-parallel (kernel ``P(None, "model")``, bias ``P("model")``) and
-the second row-parallel (``P("model", None)``, replicated bias), so the
-two big matmuls per layer run on 1/M of the hidden dim per device and
-XLA inserts exactly one all-reduce per MLP. Attention params stay
-replicated (the fused qkv kernel's output axis crosses q/k/v boundaries
-when sliced naively; head-aligned attention TP is what
-``dptpu.ops.sequence_parallel`` + shard_map are for). Composes with
-data parallelism over the ``data`` axis of the same mesh: batch sharded
-``P("data")``, gradients all-reduced by the partitioner.
+Shipped sharding rule: **Megatron-style tensor parallelism for the
+full ViT encoder layer** (``vit_tp_specs``) — MLP column→row parallel
+AND head-aligned attention TP (qkv column-parallel by head groups,
+out-proj row-parallel; the head-major fused-qkv storage layout in
+dptpu/models/vit.py is what makes the contiguous split head-aligned).
+Exactly two partitioner-inserted all-reduces per encoder layer — one
+per MLP, one per attention block — locked by the HLO inspection test
+in tests/test_gspmd.py. Composes with data parallelism over the
+``data`` axis of the same mesh: batch sharded ``P("data")``, gradients
+all-reduced by the partitioner.
 
 Semantics note: under GSPMD the whole global batch is one logical
 program, so any BatchNorm computes GLOBAL batch statistics (SyncBN
@@ -38,15 +37,32 @@ from dptpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
 def vit_tp_specs(params):
-    """PartitionSpec tree for ViT: Megatron MLP tensor parallelism over
-    the ``model`` axis, everything else replicated."""
+    """PartitionSpec tree for ViT: Megatron tensor parallelism over the
+    ``model`` axis for BOTH halves of every encoder layer, everything
+    else replicated.
+
+    MLP: first Linear column-parallel (kernel ``P(None, "model")``, bias
+    ``P("model")``), second row-parallel (``P("model", None)``,
+    replicated bias) — one partitioner-inserted all-reduce per MLP.
+
+    Attention, head-aligned: the fused qkv kernel's output axis is
+    stored head-major (``(heads, 3, hd)`` flattened — see
+    dptpu/models/vit.py SelfAttention), so its contiguous
+    ``P(None, "model")`` split assigns each device a whole head GROUP
+    (q, k and v) whenever the model-axis size divides ``heads`` — the
+    projection is column-parallel, the per-head attention math is
+    embarrassingly parallel over the sharded heads axis, and the
+    row-parallel ``out_proj`` (``P("model", None)``) closes the block
+    with its single all-reduce. Mesh sizes that do not divide ``heads``
+    still compile (GSPMD reshards) but lose the alignment; ViT heads are
+    12/16, so 2/4-way model axes are always aligned."""
 
     def spec(path, leaf):
         names = [p.key for p in path]
         mod = names[-2] if len(names) > 1 else ""
-        if mod == "mlp_1":  # column-parallel: split the 4h hidden dim
+        if mod in ("mlp_1", "in_proj"):  # column-parallel
             return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
-        if mod == "mlp_2":  # row-parallel: split the input dim
+        if mod in ("mlp_2", "out_proj"):  # row-parallel: split the input dim
             return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
         return P()
 
@@ -55,22 +71,11 @@ def vit_tp_specs(params):
 
 def _opt_shardings(opt_state, pshard, rep):
     """Momentum (optax ``TraceState``) mirrors the param tree exactly, so
-    it takes the param shardings STRUCTURALLY (matching by shape alone
-    would misplace a replicated param whose shape collides with a
-    TP-sharded one); every other optimizer leaf replicates."""
-    import optax
+    it takes the param shardings STRUCTURALLY; every other optimizer
+    leaf replicates (shared walk: dptpu/train/state.py map_momentum)."""
+    from dptpu.train.state import map_momentum
 
-    def rec(node):
-        if isinstance(node, optax.TraceState):
-            return optax.TraceState(trace=pshard)
-        if isinstance(node, (tuple, list)) and not hasattr(node, "shape"):
-            children = [rec(c) for c in node]
-            if hasattr(node, "_fields"):  # NamedTuple (optax states)
-                return type(node)(*children)
-            return children if isinstance(node, list) else tuple(children)
-        return jax.tree_util.tree_map(lambda _: rep, node)
-
-    return rec(opt_state)
+    return map_momentum(opt_state, lambda _: pshard, lambda _: rep)
 
 
 def state_shardings(state, mesh: Mesh, param_specs):

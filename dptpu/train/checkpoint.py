@@ -9,8 +9,17 @@ best, imagenet_ddp.py:327-330); writes are single-writer (the
 ``rank % ngpus == 0`` guard, imagenet_ddp.py:215 — here ``process_index==0``)
 and atomic (tmp + rename), which the reference is not. Unlike torch.load
 there is no ``map_location`` dance: restored arrays are host numpy until the
-next step's sharded ``device_put`` places them (SURVEY.md §3.5 caveat (d):
-we keep a native pytree, not a ``module.``-prefixed state dict).
+next step's sharded ``device_put`` places them.
+
+``--resume`` also accepts the REFERENCE'S OWN checkpoints
+(imagenet_ddp.py:216-222: ``torch.save({epoch, arch, state_dict,
+best_acc1, optimizer})`` with DDP's ``module.``-prefixed keys): a file
+that is not a flax-serialized payload routes through the torchvision key
+map (dptpu/models/pretrained.py) to restore params/batch_stats, and the
+SGD ``momentum_buffer``s map onto the optax trace (same semantics:
+both store ``buf`` with ``p -= lr·buf``), closing SURVEY §3.5 caveat
+(d). The global step is rebuilt as ``epoch · steps_per_epoch`` so the
+LR schedule resumes on the reference's epoch boundary.
 """
 
 from __future__ import annotations
@@ -21,6 +30,9 @@ from typing import Optional
 
 import jax
 from flax import serialization
+
+from dptpu.models.pretrained import QKV_LAYOUT
+from dptpu.train.state import map_momentum
 
 CHECKPOINT_NAME = "checkpoint.pth.tar"
 BEST_NAME = "model_best.pth.tar"
@@ -50,6 +62,10 @@ def save_checkpoint(
         "batch_stats": jax.device_get(state.batch_stats),
         "opt_state": jax.device_get(state.opt_state),
         "training_time": -1.0 if training_time is None else float(training_time),
+        # attention-storage layout marker: lets a future layout change
+        # (like round 4's [q|k|v]-major -> head-major move) detect and
+        # migrate old files instead of silently scrambling them
+        "qkv_layout": QKV_LAYOUT,
     }
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, filename)
@@ -62,15 +78,30 @@ def save_checkpoint(
     return path
 
 
-def load_checkpoint(path: str, state):
+def load_checkpoint(path: str, state, arch: Optional[str] = None,
+                    steps_per_epoch: Optional[int] = None):
     """Resume: restore state + bookkeeping from a checkpoint file.
 
     The reference restores start_epoch/best_acc1/model/optimizer
     (imagenet_ddp.py:138-153). Returns ``(state, meta)`` where meta has
     ``epoch`` (resume start epoch), ``arch``, ``best_acc1``.
+
+    Accepts dptpu's flax-serialized payload OR a reference-produced
+    ``torch.save`` checkpoint (detected by failed flax deserialization;
+    see module docstring). ``arch`` names the key map for the torch
+    path (the checkpoint's own ``arch`` field wins when present);
+    ``steps_per_epoch`` rebuilds the global step from the torch
+    checkpoint's epoch, which stores no step count.
     """
     with open(path, "rb") as f:
         raw = f.read()
+    # dispatch on the file's magic, not on a failed parse: a torch file is
+    # a zip (PK..) or legacy pickle (protocol-2 \x80 prefix); anything
+    # else goes to flax so a genuinely corrupt/mismatched flax payload
+    # surfaces its own precise error instead of an unpickling one (and
+    # the torch path never pays for building the flax template)
+    if raw[:4] == b"PK\x03\x04" or raw[:2] == b"\x80\x02":
+        return _load_torch_checkpoint(path, state, arch, steps_per_epoch)
     template = {
         "epoch": 0,
         "arch": "",
@@ -80,18 +111,132 @@ def load_checkpoint(path: str, state):
         "batch_stats": jax.device_get(state.batch_stats),
         "opt_state": jax.device_get(state.opt_state),
         "training_time": -1.0,
+        "qkv_layout": "",
     }
-    payload = serialization.from_bytes(template, raw)
+    try:
+        payload = serialization.from_bytes(template, raw)
+    except Exception:
+        # pre-round-4 payload without the qkv_layout field: retry with
+        # the legacy template, then migrate ViT attention columns from
+        # [q|k|v]-major to head-major (see dptpu/models/vit.py)
+        legacy = {k: v for k, v in template.items() if k != "qkv_layout"}
+        payload = serialization.from_bytes(legacy, raw)
+        payload["qkv_layout"] = ""
+    params = payload["params"]
+    opt_state = payload["opt_state"]
+    ckpt_arch = payload["arch"] or arch or ""
+    if ckpt_arch.startswith("vit_") and payload["qkv_layout"] != QKV_LAYOUT:
+        from dptpu.models.pretrained import _qkv_to_head_major
+
+        params = _qkv_to_head_major(ckpt_arch, params)
+        opt_state = map_momentum(
+            opt_state, lambda t: _qkv_to_head_major(ckpt_arch, t)
+        )
     new_state = state.replace(
         step=payload["step"],
-        params=payload["params"],
+        params=params,
         batch_stats=payload["batch_stats"],
-        opt_state=payload["opt_state"],
+        opt_state=opt_state,
     )
     meta = {
         "epoch": int(payload["epoch"]),
         "arch": payload["arch"],
         "best_acc1": float(payload["best_acc1"]),
         "training_time": float(payload["training_time"]),
+    }
+    return new_state, meta
+
+
+def _load_torch_checkpoint(path: str, state, arch: Optional[str],
+                           steps_per_epoch: Optional[int]):
+    """Resume from the reference's own ``torch.save`` checkpoint
+    (imagenet_ddp.py:216-222): ``module.``-prefixed state dict through
+    the torchvision key map, SGD momentum buffers onto the optax trace.
+    """
+    import numpy as np
+    import torch
+
+    from dptpu.models.pretrained import (
+        _from_torch,
+        convert_state_dict,
+        torch_key_map,
+    )
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    arch = str(ckpt.get("arch") or arch or "")
+    if not arch:
+        raise ValueError(
+            f"{path}: torch-format checkpoint carries no 'arch' and none "
+            "was passed — cannot build the key map"
+        )
+    raw_sd = ckpt["state_dict"]
+    sd = {}
+    param_keys = []  # state-dict order minus buffers == parameters() order
+    for k, v in raw_sd.items():
+        k = k[len("module."):] if k.startswith("module.") else k
+        if k.endswith("num_batches_tracked"):
+            continue  # torch BN bookkeeping; no dptpu equivalent
+        sd[k] = v.detach().cpu().numpy()
+        if not k.endswith(("running_mean", "running_var")):
+            param_keys.append(k)
+    template = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+    }
+    variables = convert_state_dict(arch, sd, template)
+
+    # SGD momentum: torch keys state entries by global param index in
+    # param_groups order — identical to parameters() order, which is the
+    # state-dict key order with buffers filtered out (param_keys above)
+    kmap = torch_key_map(arch, template)
+    opt_sd = ckpt.get("optimizer") or {}
+    indices = [
+        i for g in opt_sd.get("param_groups", []) for i in g["params"]
+    ]
+    torch_state = opt_sd.get("state", {})
+    buffers = {}
+    for pos, idx in enumerate(indices):
+        buf = torch_state.get(idx, {}).get("momentum_buffer")
+        if buf is None or pos >= len(param_keys):
+            continue
+        collection, names, kind = kmap[param_keys[pos]]
+        if collection == "params":
+            buffers[names] = _from_torch(
+                buf.detach().cpu().numpy(), kind
+            ).astype(np.float32)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        variables["params"]
+    )
+    trace_leaves = []
+    for leaf_path, leaf in flat:
+        names = tuple(p.key for p in leaf_path)
+        buf = buffers.get(names)
+        if buf is not None and buf.shape != leaf.shape:
+            raise ValueError(
+                f"momentum buffer for {'.'.join(names)}: shape "
+                f"{buf.shape} != param {leaf.shape}"
+            )
+        trace_leaves.append(
+            np.zeros_like(leaf) if buf is None else buf
+        )
+    new_trace = jax.tree_util.tree_unflatten(treedef, trace_leaves)
+
+    epoch = int(ckpt.get("epoch", 0))
+    step = jax.device_get(state.step)
+    if steps_per_epoch is not None:
+        step = np.asarray(epoch * int(steps_per_epoch), dtype=step.dtype)
+    new_state = state.replace(
+        step=step,
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=map_momentum(
+            jax.device_get(state.opt_state), lambda _: new_trace
+        ),
+    )
+    meta = {
+        "epoch": epoch,
+        "arch": arch,
+        "best_acc1": float(ckpt.get("best_acc1", 0.0)),
+        "training_time": float(ckpt.get("training_time", -1.0)),
     }
     return new_state, meta

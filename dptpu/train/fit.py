@@ -90,6 +90,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
 
     single_device = cfg.gpu is not None or jax.device_count() == 1
     mesh = None if single_device else make_mesh()
+    if cfg.multiprocessing_distributed and verbose:
+        # accepted-and-mapped, never silent: the reference forks one
+        # process per GPU (nd_imagenet.py:72-76); dptpu is one process
+        # per HOST driving every local chip through the mesh, so the
+        # flag's intent (use all local accelerators) is already the
+        # default and spawning would only duplicate work.
+        print(
+            "=> --multiprocessing-distributed noted: dptpu always drives "
+            "all local chips from one process per host (SPMD mesh); no "
+            "worker processes are spawned"
+        )
     put = (
         partial(jax.device_put, device=jax.local_devices()[cfg.gpu or 0])
         if single_device
@@ -219,7 +230,13 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     best_acc1, start_epoch = 0.0, cfg.start_epoch
     if cfg.resume:
         if os.path.isfile(cfg.resume):
-            state, meta = load_checkpoint(cfg.resume, state)
+            # arch + steps_per_epoch let a reference-produced torch
+            # checkpoint resume too (key-mapped params/momentum, step
+            # rebuilt on the epoch boundary — see train/checkpoint.py)
+            state, meta = load_checkpoint(
+                cfg.resume, state, arch=cfg.arch,
+                steps_per_epoch=steps_per_epoch,
+            )
             start_epoch = meta["epoch"] if cfg.start_epoch == 0 else cfg.start_epoch
             best_acc1 = meta["best_acc1"]
             if verbose:
@@ -354,6 +371,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             )
             writer.add_scalar("Time/train", train_stats["batch_time"], epoch + 1)
             writer.add_scalar("Time/val", val_bt, epoch + 1)
+            # feed-rate accounting: loader wait per step + the fraction of
+            # the epoch the chip spent starved for host data
+            writer.add_scalar("Time/data", train_stats["data_time"], epoch + 1)
+            writer.add_scalar(
+                "Starvation/train", train_stats["starvation"], epoch + 1
+            )
             writer.add_scalar("Loss/train", train_stats["loss"], epoch + 1)
             writer.add_scalar("Loss/val", val_stats["loss"], epoch + 1)
             writer.add_scalar("Top1/train", train_stats["top1"], epoch + 1)

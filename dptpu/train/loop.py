@@ -93,6 +93,11 @@ def train_one_epoch(
         "lr": last_lr,
         "batch_time": batch_time.avg,
         "data_time": data_time.avg,
+        # fraction of epoch wall time spent WAITING on host data — the
+        # feed-rate health number (≈0 when the loader keeps up; → 1 when
+        # the chip starves; the reference watches the same ratio through
+        # its Data meter, imagenet_ddp_apex.py:304-351)
+        "starvation": data_time.sum / max(batch_time.sum, 1e-9),
         "num_batches": i + 1,
     }
     return state, stats

@@ -47,6 +47,34 @@ def make_optimizer(
     )
 
 
+def map_momentum(opt_state, trace_fn, leaf_fn=None):
+    """Structurally rebuild an optax chain state: each ``TraceState``'s
+    momentum trace maps through ``trace_fn(trace)``; every other leaf
+    maps through ``leaf_fn`` (identity when None).
+
+    Structural — matching by tree position, never by shape — because a
+    replicated param's shape can collide with a sharded one's. The ONE
+    walk shared by GSPMD sharding trees (dptpu/parallel/gspmd.py),
+    torch-checkpoint momentum restore (dptpu/train/checkpoint.py), and
+    any future optimizer-state surgery.
+    """
+    import optax
+
+    def rec(node):
+        if isinstance(node, optax.TraceState):
+            return optax.TraceState(trace=trace_fn(node.trace))
+        if isinstance(node, (tuple, list)) and not hasattr(node, "shape"):
+            children = [rec(c) for c in node]
+            if hasattr(node, "_fields"):  # NamedTuple (optax states)
+                return type(node)(*children)
+            return children if isinstance(node, list) else tuple(children)
+        if leaf_fn is None:
+            return node
+        return jax.tree_util.tree_map(leaf_fn, node)
+
+    return rec(opt_state)
+
+
 def create_train_state(
     rng: jax.Array,
     model,

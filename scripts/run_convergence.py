@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""On-chip convergence proxy — the reference's acceptance test, scaled
+to what this environment can run.
+
+The reference's verification story is convergence-as-acceptance:
+ResNet-50 trains until 75% top-1 and early-stops, recording
+``training_time`` (imagenet_ddp.py:224-236). ImageNet is not available
+here and would take days; this is the strongest proxy that runs in
+minutes on the real chip: ResNet-18 on a DETERMINISTIC nontrivial
+10-class dataset (class-dependent color + oriented-stripe texture +
+heavy noise — harder than pure mean separation: the stripes force the
+conv stack to learn orientation filters), trained through the FULL
+fit() path (JPEG decode, loader, schedule, checkpointing) twice — fp32
+and bf16 — with the same seed.
+
+Asserts (1) both dtypes clear a top-1 bar and (2) bf16 does not land
+BELOW fp32 by more than a stated delta — the mixed-precision contract
+the Apex path claims (--opt-level O2). The check is one-sided: bf16
+finishing ABOVE fp32 (it does here; the low-precision noise acts as
+regularization on this small dataset) is not a failure. Writes
+CONVERGENCE.json at the repo root with seeds, bars, and both curves.
+
+Usage: python scripts/run_convergence.py [--epochs 12] [--out CONVERGENCE.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+TOP1_BAR = 80.0          # both dtypes must clear this
+BF16_MAX_DELTA = 5.0     # bf16 may trail fp32 top-1 by at most this
+
+N_CLASSES = 10
+TRAIN_PER_CLASS = 200    # 2,000 train images
+VAL_PER_CLASS = 40       # 400 val images
+IMAGE = 40               # stored size; trained at 32
+
+
+def make_dataset(root: str, seed: int = 0) -> None:
+    """10 classes separated by hue AND stripe orientation/frequency,
+    under noise strong enough that single-pixel statistics are not
+    sufficient — the conv stack has to learn texture."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:IMAGE, 0:IMAGE].astype(np.float32)
+    for split, per_class in (("train", TRAIN_PER_CLASS), ("val", VAL_PER_CLASS)):
+        for cls in range(N_CLASSES):
+            d = os.path.join(root, split, f"class{cls}")
+            os.makedirs(d, exist_ok=True)
+            angle = np.pi * cls / N_CLASSES
+            freq = 0.25 + 0.06 * (cls % 5)
+            base = np.array([
+                100 + 100 * np.sin(2 * np.pi * cls / N_CLASSES),
+                100 + 100 * np.sin(2 * np.pi * cls / N_CLASSES + 2.1),
+                100 + 100 * np.sin(2 * np.pi * cls / N_CLASSES + 4.2),
+            ])
+            for i in range(per_class):
+                phase = rng.uniform(0, 2 * np.pi)
+                wave = np.sin(
+                    freq * (np.cos(angle) * xx + np.sin(angle) * yy) + phase
+                )
+                img = base[None, None, :] * (0.6 + 0.4 * wave[..., None])
+                img = img + rng.normal(0, 40, img.shape)
+                Image.fromarray(
+                    np.clip(img, 0, 255).astype(np.uint8)
+                ).save(os.path.join(d, f"{i}.jpg"), quality=90)
+
+
+def run_one(data_root: str, opt_level: str, epochs: int, seed: int):
+    from dptpu.config import Config
+    from dptpu.train import fit
+
+    cfg = Config(
+        data=data_root,
+        arch="resnet18",
+        epochs=epochs,
+        batch_size=256,
+        lr=0.1,
+        momentum=0.9,
+        weight_decay=1e-4,
+        workers=8,
+        print_freq=50,
+        seed=seed,
+        variant="apex",          # the bf16 (O2) / fp32 (O0) switch
+        opt_level=opt_level,
+        dist_url="env://",
+    )
+    t0 = time.time()
+    result = fit(cfg, image_size=32, verbose=False)
+    return {
+        "opt_level": opt_level,
+        "dtype": "bfloat16" if opt_level != "O0" else "float32",
+        "best_top1": result["best_acc1"],
+        "final_top1": result["history"][-1]["val_top1"],
+        "final_train_loss": result["history"][-1]["train_loss"],
+        "top1_curve": [round(h["val_top1"], 2) for h in result["history"]],
+        "wall_seconds": round(time.time() - t0, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="CONVERGENCE.json")
+    ap.add_argument("--keep-data", action="store_true")
+    args = ap.parse_args()
+
+    import atexit
+    import shutil
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="dptpu_convergence_")
+    make_dataset(tmp, seed=0)
+    ckpt_dir = tempfile.mkdtemp(prefix="dptpu_convergence_ckpt_")
+    os.chdir(ckpt_dir)  # checkpoints land here, not in the repo
+    if not args.keep_data:
+        atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+        atexit.register(shutil.rmtree, ckpt_dir, ignore_errors=True)
+    else:
+        print(f"dataset: {tmp}  checkpoints: {ckpt_dir}")
+
+    runs = [
+        run_one(tmp, "O0", args.epochs, args.seed),
+        run_one(tmp, "O2", args.epochs, args.seed),
+    ]
+    fp32, bf16 = runs
+    delta = bf16["best_top1"] - fp32["best_top1"]  # negative = bf16 worse
+    ok_bar = min(r["best_top1"] for r in runs) >= TOP1_BAR
+    ok_delta = delta >= -BF16_MAX_DELTA
+    report = {
+        "dataset": {
+            "classes": N_CLASSES,
+            "train_images": N_CLASSES * TRAIN_PER_CLASS,
+            "val_images": N_CLASSES * VAL_PER_CLASS,
+            "generator": "hue + oriented-stripe texture + sigma-40 noise, "
+                         "deterministic seed 0 (scripts/run_convergence.py)",
+        },
+        "arch": "resnet18",
+        "image_size": 32,
+        "epochs": args.epochs,
+        "seed": args.seed,
+        "device": str(jax.devices()[0].device_kind),
+        "backend": jax.default_backend(),
+        "top1_bar": TOP1_BAR,
+        "bf16_max_delta": BF16_MAX_DELTA,
+        "runs": runs,
+        "bf16_vs_fp32_delta": round(delta, 2),
+        "pass_top1_bar": ok_bar,
+        "pass_bf16_delta": ok_delta,
+        "pass": ok_bar and ok_delta,
+    }
+    out = args.out if os.path.isabs(args.out) else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), args.out
+    )
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: report[k] for k in (
+        "device", "backend", "bf16_vs_fp32_delta", "pass_top1_bar",
+        "pass_bf16_delta", "pass")}))
+    print(f"fp32 best top1 {fp32['best_top1']:.2f} "
+          f"({fp32['wall_seconds']}s), bf16 {bf16['best_top1']:.2f} "
+          f"({bf16['wall_seconds']}s); wrote {out}")
+    if not report["pass"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

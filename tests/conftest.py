@@ -25,9 +25,47 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Two-tier suite: `-m fast` is the quick all-unit check (~1 min on one
+# CPU, no model compiles); everything else is the compile-heavy `slow`
+# tier. Modules are the marking unit — a whole file is fast only if none
+# of its tests build/compile a zoo model or run fit().
+_FAST_MODULES = {
+    "test_config", "test_schedules", "test_metrics", "test_meters",
+    "test_data", "test_tensorboard", "test_native",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = item.module.__name__.rsplit(".", 1)[-1] if item.module else ""
+        item.add_marker(
+            pytest.mark.fast if name in _FAST_MODULES else pytest.mark.slow
+        )
+
 
 @pytest.fixture(scope="session")
 def eight_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 fake devices, got {len(devices)}"
     return devices[:8]
+
+
+@pytest.fixture(scope="session")
+def tiny_imagenet(tmp_path_factory):
+    """ImageFolder-shaped 3-class dataset with class-separable means —
+    shared by the fit()-level integration tests."""
+    import numpy as np
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("tinyimg")
+    rng = np.random.RandomState(0)
+    for split, per_class in [("train", 24), ("val", 8)]:
+        for cls in range(3):
+            d = root / split / f"class{cls}"
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                # class-dependent mean so the model can actually learn
+                base = np.full((40, 40, 3), 60 + 70 * cls, np.uint8)
+                noise = rng.randint(0, 40, base.shape, dtype=np.uint8)
+                Image.fromarray(base + noise).save(d / f"{i}.png")
+    return str(root)

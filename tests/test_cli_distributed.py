@@ -58,6 +58,24 @@ def test_distributed_flags_train_end_to_end(variant, extra, tmp_path,
     assert np.isfinite(result["history"][0]["train_loss"])
 
 
+def test_multiprocessing_distributed_prints_notice(tmp_path, monkeypatch,
+                                                   capsys):
+    """--multiprocessing-distributed is a deliberate no-op (one process
+    per host drives every chip) but must SAY so, like DPTPU_ZERO1 /
+    DPTPU_S2D do — no silent flag swallowing (VERDICT r3 #8)."""
+    monkeypatch.chdir(tmp_path)
+    cfg = parse_config(
+        ["synthetic:48", "-a", "resnet18", "-b", "16", "--epochs", "1",
+         "-j", "2", "--lr", "0.01", "--multiprocessing-distributed"],
+        variant="nd",
+    )
+    result = fit(cfg, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    out = capsys.readouterr().out
+    assert "--multiprocessing-distributed noted" in out
+    assert "no worker processes are spawned" in out
+
+
 def test_full_val_mode_counts_once_per_dataset(tmp_path, monkeypatch):
     """ddp/nd report count == len(val) in full-val mode (single host), the
     imagenet_ddp.py:186-194 behavior; apex's sharded val reports the same

@@ -9,26 +9,11 @@ import os
 
 import numpy as np
 import pytest
-from PIL import Image
 
 from dptpu.config import Config
 from dptpu.train import fit
 
-
-@pytest.fixture(scope="module")
-def tiny_imagenet(tmp_path_factory):
-    root = tmp_path_factory.mktemp("tinyimg")
-    rng = np.random.RandomState(0)
-    for split, per_class in [("train", 24), ("val", 8)]:
-        for cls in range(3):
-            d = root / split / f"class{cls}"
-            d.mkdir(parents=True)
-            for i in range(per_class):
-                # class-dependent mean so the model can actually learn
-                base = np.full((40, 40, 3), 60 + 70 * cls, np.uint8)
-                noise = rng.randint(0, 40, base.shape, dtype=np.uint8)
-                Image.fromarray(base + noise).save(d / f"{i}.png")
-    return str(root)
+# the shared tiny_imagenet ImageFolder fixture lives in conftest.py
 
 
 def test_fit_trains_checkpoints_and_early_stops(tiny_imagenet, tmp_path,
@@ -50,6 +35,8 @@ def test_fit_trains_checkpoints_and_early_stops(tiny_imagenet, tmp_path,
     assert os.path.exists("checkpoint.pth.tar")
     hist = result["history"]
     assert hist[0]["train_loss"] > 0
+    # feed-rate accounting: starvation fraction is present and sane
+    assert 0.0 <= hist[0]["train_starvation"] <= 1.0
     if result["early_stopped"]:
         assert result["training_time"] > 0
         assert result["best_acc1"] >= 50.0

@@ -37,7 +37,7 @@ def _batch(n=16, seed=0):
     }
 
 
-def test_vit_tp_specs_select_mlp_only():
+def test_vit_tp_specs_cover_mlp_and_attention():
     state = _vit_state()
     specs = vit_tp_specs(state.params)
     layer = specs["encoder"]["encoder_layer_0"]
@@ -45,8 +45,54 @@ def test_vit_tp_specs_select_mlp_only():
     assert layer["mlp_1"]["bias"] == P("model")
     assert layer["mlp_2"]["kernel"] == P("model", None)
     assert layer["mlp_2"]["bias"] == P()
-    assert layer["self_attention"]["in_proj"]["kernel"] == P()
+    # head-aligned attention TP: qkv column-parallel (head-major storage
+    # layout makes the contiguous split head-aligned), out-proj row-parallel
+    attn = layer["self_attention"]
+    assert attn["in_proj"]["kernel"] == P(None, "model")
+    assert attn["in_proj"]["bias"] == P("model")
+    assert attn["out_proj"]["kernel"] == P("model", None)
+    assert attn["out_proj"]["bias"] == P()
     assert specs["conv_proj"]["kernel"] == P()
+
+
+def test_gspmd_forward_hlo_one_all_reduce_per_block(eight_devices):
+    """The partitioned forward HLO must contain EXACTLY one all-reduce
+    per MLP and one per attention block (2 x layers total): the
+    head-aligned qkv split means no resharding collectives appear."""
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(eight_devices, {"data": 2, "model": 4})
+    state = _vit_state()
+    specs = vit_tp_specs(state.params)
+    pshard = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs
+    )
+
+    def forward(params, images):
+        return state.apply_fn({"params": params}, images, train=False)
+
+    # logits stay batch-sharded: a replicated output would add one
+    # legitimate (non-TP) all-gather over the data axis and muddy the count
+    images = jnp.zeros((8, 64, 64, 3), jnp.float32)
+    compiled = (
+        jax.jit(
+            forward,
+            in_shardings=(pshard, NamedSharding(mesh, P("data"))),
+            out_shardings=NamedSharding(mesh, P("data")),
+        )
+        .lower(state.params, images)
+        .compile()
+    )
+    hlo = compiled.as_text()
+    n_layers = 12  # vit_b_32
+    n_allreduce = hlo.count("all-reduce(")
+    n_allreduce += hlo.count("all-reduce-start(")
+    assert n_allreduce == 2 * n_layers, (
+        f"expected {2 * n_layers} all-reduces, found {n_allreduce}"
+    )
+    # and no gather/all-to-all resharding sneaks in
+    for bad in ("all-gather(", "all-to-all(", "collective-permute("):
+        assert hlo.count(bad) == 0, f"unexpected {bad} in partitioned HLO"
 
 
 def test_gspmd_tp_dp_step_matches_single_device(eight_devices):

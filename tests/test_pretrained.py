@@ -20,6 +20,7 @@ from dptpu.models.pretrained import (
     find_weights,
     load_npz,
     load_pretrained_variables,
+    npz_meta,
     save_npz,
     torch_key_map,
 )
@@ -250,6 +251,64 @@ def test_npz_round_trip_and_runtime_load(tmp_path, monkeypatch):
     model5 = create_model("resnet18", num_classes=5)
     with pytest.raises(ValueError, match="num_classes|shape"):
         load_pretrained_variables("resnet18", model5, input_shape=(1, 32, 32, 3))
+
+
+def test_vit_npz_layout_marker_and_legacy_migration(tmp_path, monkeypatch):
+    """Converted npz files are stamped with the head-major qkv layout;
+    an UNSTAMPED ViT file (pre-round-4 conversion, [q|k|v]-major) is
+    permuted on load so old conversions keep working bit-for-bit."""
+    rng = np.random.RandomState(3)
+    model, template = _init_vars("vit_b_32", image=64)
+    sd = _fake_torch_sd("vit_b_32", template, rng)
+    converted = convert_state_dict("vit_b_32", sd, template)
+    new_path = str(tmp_path / "vit_b_32.npz")
+    save_npz(new_path, converted)
+    assert npz_meta(new_path)["qkv_layout"] == "head_major"
+
+    # forge a legacy file: same values but with in_proj in [q|k|v]-major
+    # order and NO marker — exactly what a round-3 converter wrote
+    from dptpu.models.pretrained import _qkv_to_head_major
+
+    heads = 12
+
+    def to_legacy(path, leaf):
+        names = tuple(p.key for p in path)
+        if len(names) >= 2 and names[-2] == "in_proj":
+            if names[-1] == "kernel":
+                h = leaf.shape[0]
+                return leaf.reshape(h, heads, 3, h // heads).transpose(
+                    0, 2, 1, 3).reshape(h, 3 * h)
+            h = leaf.shape[0] // 3
+            return leaf.reshape(heads, 3, h // heads).transpose(
+                1, 0, 2).reshape(3 * h)
+        return leaf
+
+    legacy = jax.tree_util.tree_map_with_path(to_legacy, converted)
+    # round-trip sanity: migrating the forged legacy tree restores it
+    migrated = _qkv_to_head_major("vit_b_32", legacy)
+    np.testing.assert_array_equal(
+        migrated["params"]["encoder"]["encoder_layer_0"]["self_attention"]
+        ["in_proj"]["kernel"],
+        converted["params"]["encoder"]["encoder_layer_0"]["self_attention"]
+        ["in_proj"]["kernel"],
+    )
+    legacy_dir = tmp_path / "legacy"
+    legacy_dir.mkdir()
+    flat = {}
+    for collection in ("params", "batch_stats"):
+        for p, leaf in jax.tree_util.tree_flatten_with_path(
+                legacy.get(collection, {}))[0]:
+            flat[collection + "/" + "/".join(k.key for k in p)] = \
+                np.asarray(leaf)
+    np.savez(str(legacy_dir / "vit_b_32.npz"), **flat)  # no __meta__ key
+
+    monkeypatch.setenv("DPTPU_PRETRAINED_DIR", str(legacy_dir))
+    loaded = load_pretrained_variables(
+        "vit_b_32", model, input_shape=(1, 64, 64, 3)
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(loaded),
+                    jax.tree_util.tree_leaves(converted)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_create_model_pretrained_gate(tmp_path, monkeypatch):
