@@ -36,6 +36,31 @@ from dptpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 # NOTE: dptpu.train imports stay lazy (same cycle as dptpu/parallel/zero.py).
 
 
+def dp_specs(params):
+    """Pure data-parallel PartitionSpec tree for ANY zoo model: every
+    param replicated, the batch sharded ``P("data")`` by the step's
+    in_shardings — the GSPMD/pjit expression of DDP, usable by all 79
+    archs (the shard_map step in dptpu/train/step.py is the explicit
+    twin). The partitioner derives the gradient all-reduce from the
+    shardings alone.
+
+    Semantics note (same as the module docstring): under GSPMD the
+    global batch is one logical program, so BatchNorm computes GLOBAL
+    batch statistics — SyncBN behavior, exactly the single-device
+    big-batch step's numbers (locked in tests/test_gspmd.py on
+    resnet18). The shard_map DDP step instead keeps torch-DDP's
+    per-replica BN by default.
+
+    Conv tensor parallelism is deliberately NOT shipped: a bottleneck's
+    three convs cannot alternate Megatron column/row pairing without
+    either leaving the biggest conv replicated or paying a collective
+    per conv (the residual stream pins the block boundary layout), and
+    CNN channel counts (64-2048) are small enough that the data axis is
+    always the profitable one on TPU. ViT encoder TP (below) is where
+    the model axis earns its keep."""
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
 def vit_tp_specs(params):
     """PartitionSpec tree for ViT: Megatron tensor parallelism over the
     ``model`` axis for BOTH halves of every encoder layer, everything

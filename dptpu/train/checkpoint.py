@@ -115,10 +115,14 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     }
     try:
         payload = serialization.from_bytes(template, raw)
-    except Exception:
-        # pre-round-4 payload without the qkv_layout field: retry with
-        # the legacy template, then migrate ViT attention columns from
-        # [q|k|v]-major to head-major (see dptpu/models/vit.py)
+    except Exception as exc:
+        # Retry ONLY the known legacy shape — a pre-round-4 payload
+        # without the qkv_layout field (then migrate ViT attention
+        # columns from [q|k|v]-major to head-major, dptpu/models/vit.py).
+        # Any other failure (truncated file, wrong arch) re-raises the
+        # FIRST parse's precise error rather than a confusing retry one.
+        if "qkv_layout" not in str(exc):
+            raise
         legacy = {k: v for k, v in template.items() if k != "qkv_layout"}
         payload = serialization.from_bytes(legacy, raw)
         payload["qkv_layout"] = ""

@@ -361,6 +361,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         )
         if writer is not None:
             # the reference's 11 scalars/epoch (imagenet_ddp_apex.py:280-290)
+            # plus dptpu's two feed-rate scalars (Time/data, Starvation)
             bt = max(train_stats["batch_time"], 1e-9)
             train_throughput = derived.global_batch_size / bt
             val_bt = max(val_stats.get("batch_time", bt), 1e-9)

@@ -160,7 +160,9 @@ def main():
 
     e2e = bench_loader(os.path.join(tmp, "train"), 8, args.seconds)
     results["loader_e2e_8workers_imgs_per_sec"] = round(e2e, 1)
-    results["loader_e2e_imgs_per_sec_per_core"] = round(e2e / cores, 1)
+    results["loader_e2e_imgs_per_sec_per_core"] = round(
+        e2e / min(8, cores), 1
+    )
     print(f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s")
 
     # the honest feedability bound: how many host cores one chip needs.

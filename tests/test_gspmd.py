@@ -12,6 +12,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dptpu.models import create_model
 from dptpu.parallel import make_mesh
 from dptpu.parallel.gspmd import (
+    dp_specs,
     make_gspmd_train_step,
     shard_gspmd_state,
     state_shardings,
@@ -90,8 +91,10 @@ def test_gspmd_forward_hlo_one_all_reduce_per_block(eight_devices):
     assert n_allreduce == 2 * n_layers, (
         f"expected {2 * n_layers} all-reduces, found {n_allreduce}"
     )
-    # and no gather/all-to-all resharding sneaks in
-    for bad in ("all-gather(", "all-to-all(", "collective-permute("):
+    # and no gather/all-to-all resharding sneaks in (sync or async forms)
+    for bad in ("all-gather(", "all-gather-start(", "all-to-all(",
+                "all-to-all-start(", "collective-permute(",
+                "collective-permute-start("):
         assert hlo.count(bad) == 0, f"unexpected {bad} in partitioned HLO"
 
 
@@ -121,6 +124,70 @@ def test_gspmd_tp_dp_step_matches_single_device(eight_devices):
         np.testing.assert_allclose(
             np.asarray(gp), np.asarray(rp), rtol=2e-4, atol=2e-5
         )
+
+
+def test_gspmd_dp_any_arch_matches_single_device(eight_devices):
+    """dp_specs runs a BN-bearing CNN through the GSPMD path: 5 steps on
+    a {data: 8} mesh must equal the single-device big-batch step — under
+    GSPMD, BN sees the GLOBAL batch (SyncBN semantics), which is exactly
+    what the single-device step computes on the same batch."""
+    mesh = make_mesh(eight_devices, {"data": 8})
+    model = create_model("resnet18", num_classes=8)
+    tx = make_optimizer(momentum=0.9, weight_decay=1e-4)
+    state0 = create_train_state(
+        jax.random.PRNGKey(0), model, tx, input_shape=(1, 32, 32, 3)
+    )
+    specs = dp_specs(state0.params)
+    # lr 0.01: the default 0.1 on random data drives the loss into the
+    # chaotic regime where float-associativity differences amplify past
+    # any fixed tolerance within 5 steps (same phenomenon NUMERICS.json
+    # documents across backends)
+    lr = lambda _: 0.01  # noqa: E731
+    g_step = make_gspmd_train_step(mesh, state0, specs, lr_schedule=lr)
+    g_state = shard_gspmd_state(state0, mesh, specs)
+    ref_state = jax.tree_util.tree_map(jnp.array, state0)
+    ref_step = make_train_step(lr_schedule=lr)
+
+    def batch(seed):
+        rng = np.random.RandomState(seed)
+        return {
+            "images": rng.randint(0, 256, (16, 32, 32, 3)).astype(np.uint8),
+            "labels": rng.randint(0, 8, (16,)).astype(np.int32),
+        }
+
+    # BN batch statistics are summed in partitioned order under GSPMD
+    # (8 partial sums) vs one flat sum on the reference device. Measured
+    # on this exact setup: step-0 loss agrees to 3e-7 (the semantics are
+    # identical), then BN's 1/sigma^2 gradient terms amplify the
+    # associativity residue ~10-30x per step (3e-7 -> 2.7e-4 -> 2.2e-3
+    # -> 8.6e-3 -> 5.4e-2) — the same chaotic growth NUMERICS.json
+    # documents across backends. So the gate is the pre-amplification
+    # horizon; later steps are sanity-checked, not equality-checked.
+    bounds = [1e-5, 1e-3]
+    for i in range(5):
+        b = batch(i)
+        ref_state, ref_m = ref_step(ref_state, b)
+        g_state, g_m = g_step(g_state, b)
+        gl, rl = float(g_m["loss"]), float(ref_m["loss"])
+        if i < len(bounds):
+            np.testing.assert_allclose(gl, rl, rtol=bounds[i])
+        else:
+            assert np.isfinite(gl) and abs(gl - rl) / rl < 0.2, (i, gl, rl)
+        if i == 0:
+            # one update in: params and the pmean'd running stats must
+            # still track. A wrong collective or mis-sharded stat shows
+            # as an O(1) relative error here; BN-backward cancellation
+            # makes per-element gradients order-sensitive at the ~1e-3
+            # level, hence gross-error (not bitwise) tolerances.
+            for gp, rp in zip(
+                jax.tree_util.tree_leaves(
+                    (g_state.params, g_state.batch_stats)),
+                jax.tree_util.tree_leaves(
+                    (ref_state.params, ref_state.batch_stats)),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(gp), np.asarray(rp), rtol=1e-2, atol=1e-4
+                )
 
 
 def test_gspmd_state_physically_sharded(eight_devices):
