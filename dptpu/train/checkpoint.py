@@ -113,16 +113,15 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "training_time": -1.0,
         "qkv_layout": "",
     }
-    try:
+    # structural legacy detection: peek at the payload's own top-level
+    # keys (msgpack_restore raises its precise error on a corrupt file).
+    # A pre-round-4 payload has no qkv_layout field — parse it with the
+    # legacy template, then migrate ViT attention columns from
+    # [q|k|v]-major to head-major (dptpu/models/vit.py).
+    has_marker = "qkv_layout" in serialization.msgpack_restore(raw)
+    if has_marker:
         payload = serialization.from_bytes(template, raw)
-    except Exception as exc:
-        # Retry ONLY the known legacy shape — a pre-round-4 payload
-        # without the qkv_layout field (then migrate ViT attention
-        # columns from [q|k|v]-major to head-major, dptpu/models/vit.py).
-        # Any other failure (truncated file, wrong arch) re-raises the
-        # FIRST parse's precise error rather than a confusing retry one.
-        if "qkv_layout" not in str(exc):
-            raise
+    else:
         legacy = {k: v for k, v in template.items() if k != "qkv_layout"}
         payload = serialization.from_bytes(legacy, raw)
         payload["qkv_layout"] = ""
