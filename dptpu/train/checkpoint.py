@@ -113,17 +113,24 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "training_time": -1.0,
         "qkv_layout": "",
     }
-    # structural legacy detection: peek at the payload's own top-level
-    # keys (msgpack_restore raises its precise error on a corrupt file).
+    # structural legacy detection, single decode: restore the msgpack
+    # tree once (raises its precise error on a corrupt file), pick the
+    # template by the payload's own top-level keys, and validate with
+    # from_state_dict (from_bytes is exactly restore + from_state_dict).
     # A pre-round-4 payload has no qkv_layout field — parse it with the
     # legacy template, then migrate ViT attention columns from
     # [q|k|v]-major to head-major (dptpu/models/vit.py).
-    has_marker = "qkv_layout" in serialization.msgpack_restore(raw)
-    if has_marker:
-        payload = serialization.from_bytes(template, raw)
+    restored = serialization.msgpack_restore(raw)
+    if not isinstance(restored, dict):
+        raise ValueError(
+            f"{path}: checkpoint payload is {type(restored).__name__}, "
+            "not a dict — corrupt or not a dptpu checkpoint"
+        )
+    if "qkv_layout" in restored:
+        payload = serialization.from_state_dict(template, restored)
     else:
         legacy = {k: v for k, v in template.items() if k != "qkv_layout"}
-        payload = serialization.from_bytes(legacy, raw)
+        payload = serialization.from_state_dict(legacy, restored)
         payload["qkv_layout"] = ""
     params = payload["params"]
     opt_state = payload["opt_state"]
