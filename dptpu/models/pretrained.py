@@ -364,15 +364,16 @@ def torch_key_map(arch: str, variables) -> Dict[str, Tuple[str, Tuple[str, ...],
                 tleaf = _LEAF_TO_TORCH.get(names[-1], names[-1])
             else:
                 tleaf = _LEAF_TO_TORCH[names[-1]]
-            if arch.startswith("vit_") and len(names) >= 2 \
-                    and names[-2] == "in_proj":
+            if len(names) >= 2 and (
+                (arch.startswith("vit_") and names[-2] == "in_proj")
+                or (arch.startswith("swin") and names[-2] == "qkv")
+            ):
                 # fused qkv: torch stores [q|k|v]-major, dptpu stores
-                # head-major (dptpu/models/vit.py SelfAttention) — the
-                # converter permutes in addition to the OI->IO transpose
-                from dptpu.models.vit import _VARIANTS
-
-                heads = _VARIANTS[arch[len("vit_"):]][2]
-                kind = ("vit_qkv", heads, names[-1])
+                # head-major (vit.py SelfAttention / swin.py _QKVDense
+                # docstrings) — the converter permutes in addition to
+                # the OI->IO transpose. Kind tag is "vit_qkv" for
+                # historical reasons; it covers swin too.
+                kind = ("vit_qkv", _qkv_heads(arch, names), names[-1])
             elif names[-1] == "kernel":
                 if leaf.ndim == 4:
                     kind = "conv"
@@ -495,12 +496,45 @@ def convert_state_dict(arch: str, state_dict: Dict[str, np.ndarray],
 # npz round trip + runtime resolution
 # ---------------------------------------------------------------------------
 
-# Layout versioning: ViT fused-qkv columns are stored HEAD-MAJOR since
-# round 4 (dptpu/models/vit.py SelfAttention). npz files record the
-# layout under a ``__meta__/`` key; unmarked ViT files predate the change
-# (they are [q|k|v]-major) and are migrated on load. Same shapes either
-# way, so this marker is the ONLY way to tell them apart.
-QKV_LAYOUT = "head_major"
+# Layout versioning: fused-qkv columns are stored HEAD-MAJOR (see
+# dptpu/models/vit.py SelfAttention / dptpu/models/swin.py _QKVDense).
+# npz files and flax checkpoints record the layout marker; files whose
+# marker predates a family's head-major switch are [q|k|v]-major for
+# that family and get migrated on load. Same shapes either way, so the
+# marker is the ONLY way to tell them apart. History: "head_major"
+# covered ViT only (early round 4 — swin was still [q|k|v]-major under
+# that marker); "head_major2" covers ViT + Swin.
+QKV_LAYOUT = "head_major2"
+# markers under which a family's qkv leaves are ALREADY head-major
+_HEAD_MAJOR_MARKERS = {
+    "vit_": ("head_major", "head_major2"),
+    "swin": ("head_major2",),
+}
+
+
+def qkv_needs_migration(arch: str, marker) -> bool:
+    """True when an artifact with layout ``marker`` (None/"" = unmarked,
+    pre-round-4) stores ``arch``'s fused qkv in [q|k|v]-major order and
+    must be permuted to head-major on load."""
+    for prefix, ok in _HEAD_MAJOR_MARKERS.items():
+        if arch.startswith(prefix):
+            return marker not in ok
+    return False
+
+
+def _qkv_heads(arch: str, names) -> int:
+    """Head count of the fused-qkv leaf at tree path ``names`` — fixed
+    per arch for ViT, per STAGE for Swin (the stage index is parsed from
+    the ``stage{si}_block{bi}`` path element)."""
+    if arch.startswith("vit_"):
+        from dptpu.models.vit import _VARIANTS
+
+        return _VARIANTS[arch[len("vit_"):]][2]
+    from dptpu.models.swin import _VARIANTS
+
+    stage = next(n for n in names if str(n).startswith("stage"))
+    si = int(str(stage)[len("stage"):].split("_block")[0])
+    return _VARIANTS[arch[len("swin_"):]][2][si]
 
 
 def qkv_permute(arr: np.ndarray, heads: int, *, to_head_major: bool):
@@ -559,19 +593,18 @@ def npz_meta(path: str) -> Dict[str, str]:
 
 
 def _qkv_to_head_major(arch: str, variables):
-    """Migrate a [q|k|v]-major ViT tree (pre-round-4 conversion) to the
-    head-major storage layout. Works on any dict tree whose in_proj
-    leaves sit at ``…/in_proj/{kernel,bias}`` — the variables dict, a
-    bare params tree, or a momentum trace mirroring params."""
-    from dptpu.models.vit import _VARIANTS
-
-    heads = _VARIANTS[arch[len("vit_"):]][2]
+    """Migrate a [q|k|v]-major ViT/Swin tree (pre-round-4 conversion) to
+    the head-major storage layout. Works on any dict tree whose fused
+    qkv leaves sit at ``…/in_proj/{kernel,bias}`` (ViT) or
+    ``…/qkv/{kernel,bias}`` (Swin) — the variables dict, a bare params
+    tree, or a momentum trace mirroring params."""
 
     def fix(path, leaf):
         names = tuple(p.key for p in path)
-        if len(names) >= 2 and names[-2] == "in_proj":
+        if len(names) >= 2 and names[-2] in ("in_proj", "qkv"):
             return qkv_permute(
-                np.asarray(leaf), heads, to_head_major=True
+                np.asarray(leaf), _qkv_heads(arch, names),
+                to_head_major=True,
             )
         return leaf
 
@@ -616,10 +649,9 @@ def load_pretrained_variables(arch: str, model, input_shape=(1, 224, 224, 3)):
     """
     path = require_weights(arch)
     loaded = load_npz(path)
-    if arch.startswith("vit_") and \
-            npz_meta(path).get("qkv_layout") != QKV_LAYOUT:
-        # unmarked = converted before the head-major qkv storage layout:
-        # same shapes, permuted columns — migrate silently-correctly
+    if qkv_needs_migration(arch, npz_meta(path).get("qkv_layout")):
+        # converted before this family's head-major qkv switch: same
+        # shapes, permuted columns — migrate silently-correctly
         loaded = _qkv_to_head_major(arch, loaded)
     template = model.init(
         jax.random.PRNGKey(0), np.zeros(input_shape, np.float32), train=False

@@ -25,6 +25,11 @@ Init matches torchvision: every Linear trunc_normal(0.02) with zero
 bias (the SwinTransformer-level loop overrides the per-block MLP
 xavier init), patch conv torch-default, bias table trunc_normal(0.02).
 Param counts locked in tests/test_models.py (swin_t = 28,288,354).
+
+The fused qkv projection's output axis is stored HEAD-MAJOR (same
+layout, same converter permutation, and same tensor-parallelism
+rationale as dptpu/models/vit.py — see ``_QKVDense`` below and
+``swin_tp_specs`` in dptpu/parallel/gspmd.py).
 """
 
 import math
@@ -94,13 +99,18 @@ def _shift_mask(hp: int, wp: int, ws: int, sh: int, sw: int) -> np.ndarray:
 
 
 class _QKVDense(nn.Module):
-    """qkv projection whose K third of the bias is functionally zeroed —
-    torchvision's v2 attention clones ``qkv_bias`` and zeroes
-    ``[C:2C]`` on every forward, so that slice never contributes and
-    never receives gradient; the param itself stays in the checkpoint
-    layout (``attn.qkv.bias``)."""
+    """qkv projection whose K positions of the bias are functionally
+    zeroed — torchvision's v2 attention clones ``qkv_bias`` and zeroes
+    the K third on every forward, so those slots never contribute and
+    never receive gradient; the param itself stays checkpoint-shaped
+    (``attn.qkv.bias``). The output axis is stored HEAD-MAJOR
+    (``(heads, 3, hd)`` flattened — same layout and same TP rationale
+    as dptpu/models/vit.py SelfAttention; the converter permutes torch's
+    ``[q|k|v]``-major weights), so the zero mask targets the per-head K
+    slots, not a contiguous middle third."""
 
     features: int
+    heads: int
     dtype: Any
     param_dtype: Any
 
@@ -113,10 +123,10 @@ class _QKVDense(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros, (self.features,), self.param_dtype
         )
-        third = self.features // 3
-        mask = np.ones((self.features,), np.float32)
-        mask[third:2 * third] = 0.0
-        bias = bias * jnp.asarray(mask, bias.dtype)
+        mask = np.ones((self.heads, 3, self.features // (3 * self.heads)),
+                       np.float32)
+        mask[:, 1, :] = 0.0  # K slots, head-major layout
+        bias = bias * jnp.asarray(mask.reshape(-1), bias.dtype)
         return x.astype(self.dtype) @ kernel.astype(self.dtype) \
             + bias.astype(self.dtype)
 
@@ -151,16 +161,16 @@ class ShiftedWindowAttention(nn.Module):
 
         if self.v2:
             qkv = _QKVDense(
-                features=3 * c, dtype=self.dtype,
+                features=3 * c, heads=self.heads, dtype=self.dtype,
                 param_dtype=self.param_dtype, name="qkv",
             )(xw)
         else:
             qkv = dense(3 * c, name="qkv")(xw)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (xw.shape[0], ws * ws, self.heads, hd)
-        q = q.reshape(shape).transpose(0, 2, 1, 3)
-        k = k.reshape(shape).transpose(0, 2, 1, 3)
-        v = v.reshape(shape).transpose(0, 2, 1, 3)
+        # head-major fused layout (see _QKVDense docstring): split into
+        # per-head q/k/v and land directly on (batch, heads, tokens, hd)
+        qkv = qkv.reshape(xw.shape[0], ws * ws, self.heads, 3, hd)
+        qkv = qkv.transpose(0, 2, 3, 1, 4)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.v2:
             # cosine attention with per-head learned temperature
             logit_scale = self.param(

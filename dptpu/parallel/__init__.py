@@ -18,6 +18,7 @@ from dptpu.parallel.mesh import (
 from dptpu.parallel.gspmd import (
     make_gspmd_train_step,
     shard_gspmd_state,
+    swin_tp_specs,
     vit_tp_specs,
 )
 from dptpu.parallel.zero import (
@@ -39,6 +40,7 @@ __all__ = [
     "make_zero1_train_step",
     "replicated_sharding",
     "shard_gspmd_state",
+    "swin_tp_specs",
     "shard_host_batch",
     "shard_zero1_state",
     "vit_tp_specs",

@@ -94,6 +94,40 @@ def vit_tp_specs(params):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def swin_tp_specs(params):
+    """PartitionSpec tree for Swin v1/v2: Megatron tensor parallelism
+    over the ``model`` axis for every block, everything else replicated.
+
+    Same design as ``vit_tp_specs`` — the fused qkv kernel is stored
+    head-major (dptpu/models/swin.py ``_QKVDense``), so its contiguous
+    ``P(None, "model")`` split is head-aligned whenever the model-axis
+    size divides the stage's head count; ``proj`` is row-parallel. The
+    per-head side tensors shard on their heads dim too: v1's
+    relative-position-bias table, v2's ``logit_scale`` and the
+    ``cpb_mlp_2`` head projection (its 512-wide input MLP stays
+    replicated — it is tiny). MLPs are column→row as usual.
+
+    Head counts per stage are (3, 6, 12, 24)-shaped for t/s and
+    (4, 8, 16, 32) for b: a model axis of 3 (t/s) or 4 (b) is aligned
+    at EVERY stage; other sizes still compile (GSPMD reshards) but lose
+    the alignment."""
+
+    def spec(path, leaf):
+        names = [p.key for p in path]
+        mod = names[-2] if len(names) > 1 else ""
+        if mod in ("mlp_1", "qkv", "cpb_mlp_2"):  # column-parallel
+            return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
+        if mod in ("mlp_2", "proj"):  # row-parallel: split the input dim
+            return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
+        if names[-1] == "logit_scale":  # (heads, 1, 1)
+            return P(MODEL_AXIS)
+        if names[-1] == "relative_position_bias_table":  # ((2w-1)^2, heads)
+            return P(None, MODEL_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
 def _opt_shardings(opt_state, pshard, rep):
     """Momentum (optax ``TraceState``) mirrors the param tree exactly, so
     it takes the param shardings STRUCTURALLY; every other optimizer

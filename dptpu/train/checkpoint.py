@@ -31,7 +31,7 @@ from typing import Optional
 import jax
 from flax import serialization
 
-from dptpu.models.pretrained import QKV_LAYOUT
+from dptpu.models.pretrained import QKV_LAYOUT, qkv_needs_migration
 from dptpu.train.state import map_momentum
 
 CHECKPOINT_NAME = "checkpoint.pth.tar"
@@ -135,7 +135,7 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     params = payload["params"]
     opt_state = payload["opt_state"]
     ckpt_arch = payload["arch"] or arch or ""
-    if ckpt_arch.startswith("vit_") and payload["qkv_layout"] != QKV_LAYOUT:
+    if qkv_needs_migration(ckpt_arch, payload["qkv_layout"]):
         from dptpu.models.pretrained import _qkv_to_head_major
 
         params = _qkv_to_head_major(ckpt_arch, params)

@@ -263,7 +263,17 @@ def test_vit_npz_layout_marker_and_legacy_migration(tmp_path, monkeypatch):
     converted = convert_state_dict("vit_b_32", sd, template)
     new_path = str(tmp_path / "vit_b_32.npz")
     save_npz(new_path, converted)
-    assert npz_meta(new_path)["qkv_layout"] == "head_major"
+    from dptpu.models.pretrained import QKV_LAYOUT, qkv_needs_migration
+
+    assert npz_meta(new_path)["qkv_layout"] == QKV_LAYOUT
+    assert not qkv_needs_migration("vit_b_32", QKV_LAYOUT)
+    # the early-round-4 "head_major" marker covered ViT only: a swin
+    # artifact carrying it is still [q|k|v]-major and MUST migrate,
+    # while a vit artifact carrying it must NOT be re-permuted
+    assert qkv_needs_migration("swin_t", "head_major")
+    assert not qkv_needs_migration("vit_b_32", "head_major")
+    assert qkv_needs_migration("swin_v2_t", None)
+    assert not qkv_needs_migration("resnet50", None)
 
     # forge a legacy file: same values but with in_proj in [q|k|v]-major
     # order and NO marker — exactly what a round-3 converter wrote
