@@ -178,12 +178,40 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             f"=> DPTPU_S2D ignored: requires a resnet arch and even input "
             f"size (got arch={cfg.arch}, image_size={image_size})"
         )
+    # DPTPU_GSPMD=1: run the single-program GSPMD/pjit data-parallel step
+    # (dp_specs) instead of the shard_map DDP step. Read before model
+    # build because BN semantics differ: under GSPMD the global batch is
+    # one logical program, so BN statistics are ALWAYS global (SyncBN
+    # behavior) and the model must not carry a shard-local axis name.
+    want_gspmd = _os_environ_flag("DPTPU_GSPMD")
+    want_zero1 = _os_environ_flag("DPTPU_ZERO1")  # read once; the ZeRO-1
+    # block below reuses this so the precedence rule has one source
+    use_zero1 = want_zero1 and mesh is not None and not cfg.evaluate
+    use_gspmd = (
+        want_gspmd and mesh is not None and not cfg.evaluate
+        and not use_zero1
+    )
+    if want_gspmd and not use_gspmd and verbose:
+        # name ZeRO-1 as the reason only when ZeRO-1 will actually run
+        why = (
+            "DPTPU_ZERO1 takes precedence"
+            if use_zero1
+            else "--evaluate does not train"
+            if cfg.evaluate
+            else "single-device run (no mesh)"
+        )
+        print(f"=> DPTPU_GSPMD ignored: {why}")
+    if use_gspmd and derived.sync_bn and verbose:
+        print("=> --sync-bn is implicit under DPTPU_GSPMD: BatchNorm "
+              "always sees the global batch in the single-program step")
     model = create_model(
         cfg.arch,
         pretrained=cfg.pretrained,
         num_classes=num_classes,
         dtype=compute_dtype,
-        bn_axis_name="data" if (derived.sync_bn and mesh is not None) else None,
+        bn_axis_name="data"
+        if (derived.sync_bn and mesh is not None and not use_gspmd)
+        else None,
         bn_dtype=jnp.float32 if keep_bn_fp32 else None,
         # space-to-depth stem: identical math + identical params (checkpoints
         # interchange freely; parity locked in tests/test_models.py). Opt-in
@@ -246,10 +274,10 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if verbose:
                 print(f"=> no checkpoint found at '{cfg.resume}'")
 
-    want_zero1 = _os_environ_flag("DPTPU_ZERO1")
+    # want_zero1/use_zero1 were computed once, before model build (the
+    # GSPMD-precedence block) — reused here so the rule cannot desync.
     # --evaluate never trains: sharding the state only to re-gather it
-    # for validation would be two pointless full-state device_put rounds
-    use_zero1 = want_zero1 and mesh is not None and not cfg.evaluate
+    # for validation would be two pointless full-state device_put rounds.
     if want_zero1 and mesh is None and verbose:
         print("=> DPTPU_ZERO1 ignored: single-device run (no mesh to "
               "shard the optimizer state over)")
@@ -272,6 +300,26 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         eval_view = lambda s: gather_state(s, mesh)  # noqa: E731
         if verbose:
             print("=> ZeRO-1 optimizer-state sharding over the data axis")
+    elif use_gspmd:
+        # single-program GSPMD/pjit path: params replicated (dp_specs),
+        # batch sharded P("data") — the partitioner derives the gradient
+        # all-reduce. Same batch layout shard_host_batch already
+        # produces, so loaders/eval/checkpoint are unchanged.
+        from dptpu.parallel.gspmd import (
+            dp_specs,
+            make_gspmd_train_step,
+            shard_gspmd_state,
+        )
+
+        specs = dp_specs(state.params)
+        train_step = make_gspmd_train_step(
+            mesh, state, specs, compute_dtype, lr_schedule=schedule,
+            seed=cfg.seed if cfg.seed is not None else 0,
+        )
+        state = shard_gspmd_state(state, mesh, specs)
+        eval_view = lambda s: s  # noqa: E731
+        if verbose:
+            print("=> GSPMD single-program data parallelism (dp_specs)")
     else:
         train_step = make_train_step(
             mesh, compute_dtype, lr_schedule=schedule,

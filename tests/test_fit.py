@@ -80,3 +80,36 @@ def test_fit_zero1_matches_ddp(tiny_imagenet, tmp_path, monkeypatch):
     assert eval_result["val"]["top1"] == pytest.approx(
         zero["history"][-1]["val_top1"], abs=1e-6
     )
+
+
+def test_fit_gspmd_flag_trains_and_yields_to_zero1(tiny_imagenet, tmp_path,
+                                                   monkeypatch, capsys):
+    """DPTPU_GSPMD=1 routes fit() through the single-program pjit step
+    (dp_specs): trains end-to-end with global-batch BN semantics, and
+    DPTPU_ZERO1 takes precedence with a notice when both are set."""
+    from dptpu.config import Config
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("DPTPU_GSPMD", "1")
+    cfg = Config(
+        data=tiny_imagenet,
+        arch="resnet18",
+        epochs=1,
+        batch_size=24,
+        lr=0.02,
+        workers=2,
+        print_freq=1,
+        seed=1,
+    )
+    result = fit(cfg, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    assert np.isfinite(result["history"][0]["train_loss"])
+    out = capsys.readouterr().out
+    assert "GSPMD single-program data parallelism" in out
+
+    monkeypatch.setenv("DPTPU_ZERO1", "1")
+    result = fit(cfg, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    out = capsys.readouterr().out
+    assert "DPTPU_GSPMD ignored: DPTPU_ZERO1 takes precedence" in out
+    assert "ZeRO-1 optimizer-state sharding" in out
