@@ -30,8 +30,8 @@ import pytest  # noqa: E402
 # tier. Modules are the marking unit — a whole file is fast only if none
 # of its tests build/compile a zoo model or run fit().
 _FAST_MODULES = {
-    "test_config", "test_schedules", "test_metrics", "test_meters",
-    "test_data", "test_tensorboard", "test_native",
+    "test_bench_logic", "test_config", "test_schedules", "test_metrics",
+    "test_meters", "test_data", "test_tensorboard", "test_native",
 }
 
 

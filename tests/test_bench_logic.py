@@ -1,0 +1,54 @@
+"""The benchmark's self-defense decisions (bench.py), locked on CPU.
+
+Round 3's official record was one contended wall-clock capture (141
+img/s against a 46.8 ms/step device profile — 0.05x); these tests pin
+the decision layer that prevents a recurrence: implausible trials are
+rejected, the device-derived rate stands in when every wall window is
+untrustworthy, and a benchmark with nothing defensible fails loudly
+instead of printing a junk headline.
+"""
+
+import os
+import sys
+
+import pytest
+
+# bench.py lives at the repo root (cwd-independent)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+def test_plausible_window_accepted():
+    assert bench.plausible(2700.0, 2734.0)
+    assert bench.plausible(2734.0 * 1.49, 2734.0)
+    assert bench.plausible(2734.0 / 1.49, 2734.0)
+
+
+def test_contended_capture_rejected():
+    # the r03 collapse: 141 img/s against a 2734 device-derived rate
+    assert not bench.plausible(141.4, 2734.0)
+    assert not bench.plausible(2734.0 * 1.51, 2734.0)
+
+
+def test_no_device_profile_accepts_everything():
+    # CPU/profiler-off environments: no cross-check, no rejections
+    assert bench.plausible(141.4, None)
+
+
+def test_finalize_prefers_wall_median():
+    rate, source = bench.finalize([2709.0, 2748.7, 2734.3], 2734.0, [])
+    assert source == "wall_clock_two_point_diff"
+    assert rate == 2734.3  # median
+
+
+def test_finalize_falls_back_to_device_rate():
+    rejected = [{"trial": 0, "rate": 141.4,
+                 "why": "implausible_vs_device_time"}]
+    rate, source = bench.finalize([], 2734.0, rejected)
+    assert source == "device_time_op_sum_fallback"
+    assert rate == 2734.0
+
+
+def test_finalize_fails_loudly_with_nothing():
+    with pytest.raises(RuntimeError, match="benchmark unusable"):
+        bench.finalize([], None, [])
