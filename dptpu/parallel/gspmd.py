@@ -110,7 +110,12 @@ def swin_tp_specs(params):
     Head counts per stage are (3, 6, 12, 24)-shaped for t/s and
     (4, 8, 16, 32) for b: a model axis of 3 (t/s) or 4 (b) is aligned
     at EVERY stage; other sizes still compile (GSPMD reshards) but lose
-    the alignment."""
+    the alignment.
+
+    Scope note: MaxViT (the zoo's third attention family) keeps its
+    [q|k|v]-major fused qkv and no TP spec — it is a conv-attention
+    hybrid whose MBConv blocks dominate, so the data axis (``dp_specs``)
+    is the profitable one there, same verdict as pure CNNs."""
 
     def spec(path, leaf):
         names = [p.key for p in path]
