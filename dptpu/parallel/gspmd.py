@@ -133,6 +133,31 @@ def swin_tp_specs(params):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def tp_rule_for_arch(arch: str) -> str:
+    """Name the tensor-parallel sharding rule for an arch.
+
+    The two attention families with head-major fused-qkv storage get
+    real TP (``vit_*`` → ``vit_tp_specs``; ``swin*`` v1/v2 →
+    ``swin_tp_specs``); every other arch — CNNs and MaxViT
+    (conv-hybrid, see ``swin_tp_specs`` scope note) — answers
+    ``dp_specs``. Arch-name-only so ``fit()`` can decide BEFORE mesh
+    construction: a dp fallback should get the flat full-width data
+    mesh, not a factored one with a redundant model axis."""
+    if arch.startswith("vit_"):
+        return "vit_tp_specs"
+    if arch.startswith("swin"):
+        return "swin_tp_specs"
+    return "dp_specs"
+
+
+def tp_specs_for_arch(arch: str, params):
+    """``(rule_name, specs)`` for ``tp_rule_for_arch``'s choice."""
+    rule = tp_rule_for_arch(arch)
+    fn = {"vit_tp_specs": vit_tp_specs, "swin_tp_specs": swin_tp_specs,
+          "dp_specs": dp_specs}[rule]
+    return rule, fn(params)
+
+
 def _opt_shardings(opt_state, pshard, rep):
     """Momentum (optax ``TraceState``) mirrors the param tree exactly, so
     it takes the param shardings STRUCTURALLY; every other optimizer

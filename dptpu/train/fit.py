@@ -59,6 +59,21 @@ def _os_environ_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() in ("1", "true", "yes")
 
 
+def _os_environ_int(name: str) -> int:
+    """Integer env knob; unset/empty → 0, junk → actionable error."""
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (expected e.g. {name}=2)"
+        ) from None
+
+
 def _build_datasets(cfg: Config, image_size: int):
     import os
 
@@ -89,7 +104,52 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         verbose = derived.is_chief
 
     single_device = cfg.gpu is not None or jax.device_count() == 1
-    mesh = None if single_device else make_mesh()
+    # DPTPU_TP=N opens a model axis of size N on the mesh and routes
+    # training through the GSPMD tensor-parallel step (specs picked by
+    # arch below). The model axis is INNER: on multi-host pods the
+    # hierarchical mesh keeps its collectives on ICI (make_mesh guards
+    # the DCN crossing). This is the trainer-level entry for the
+    # vit/swin TP sharding rules in dptpu/parallel/gspmd.py.
+    tp_n = _os_environ_int("DPTPU_TP")
+    if tp_n < 0:
+        raise ValueError(
+            f"DPTPU_TP={tp_n} must be a positive model-axis size "
+            f"(e.g. DPTPU_TP=2)"
+        )
+    if tp_n == 1 and verbose:
+        print("=> DPTPU_TP=1 is a no-op: a one-way model axis is just "
+              "data parallelism")
+    use_tp = tp_n > 1 and not single_device and not cfg.evaluate
+    if tp_n > 1 and not use_tp and verbose:
+        why = (
+            "--evaluate does not train"
+            if cfg.evaluate and not single_device
+            else "single-device run (no mesh to open a model axis on)"
+        )
+        print(f"=> DPTPU_TP ignored: {why}")
+    # Arch rule decided BEFORE mesh construction: an arch with no TP rule
+    # (CNNs, MaxViT) gets the flat full-width data mesh — factoring a
+    # model axis it cannot use would make those devices compute 100%
+    # redundantly instead of joining the data axis.
+    tp_fallback = False
+    if use_tp:
+        from dptpu.parallel.gspmd import tp_rule_for_arch
+
+        tp_fallback = tp_rule_for_arch(cfg.arch) == "dp_specs"
+    if use_tp and not tp_fallback and jax.device_count() % tp_n != 0:
+        raise ValueError(
+            f"DPTPU_TP={tp_n} does not divide the {jax.device_count()} "
+            f"available devices — pick a divisor so the "
+            f"{{data, model}} mesh factors"
+        )
+    if single_device:
+        mesh = None
+    elif use_tp and not tp_fallback:
+        from dptpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        mesh = make_mesh(mesh_shape={DATA_AXIS: -1, MODEL_AXIS: tp_n})
+    else:
+        mesh = make_mesh()
     if cfg.multiprocessing_distributed and verbose:
         # accepted-and-mapped, never silent: the reference forks one
         # process per GPU (nd_imagenet.py:72-76); dptpu is one process
@@ -185,10 +245,18 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # behavior) and the model must not carry a shard-local axis name.
     want_gspmd = _os_environ_flag("DPTPU_GSPMD")
     want_zero1 = _os_environ_flag("DPTPU_ZERO1")  # read once; the ZeRO-1
-    # block below reuses this so the precedence rule has one source
-    use_zero1 = want_zero1 and mesh is not None and not cfg.evaluate
+    # block below reuses this so the precedence rule has one source.
+    # Precedence: DPTPU_TP (an explicit topology request — the mesh was
+    # already factored for it) > DPTPU_ZERO1 > DPTPU_GSPMD.
+    use_zero1 = (
+        want_zero1 and mesh is not None and not cfg.evaluate and not use_tp
+    )
+    if want_zero1 and use_tp and verbose:
+        print("=> DPTPU_ZERO1 ignored: DPTPU_TP drives the GSPMD "
+              "tensor-parallel step (params shard over the model axis, "
+              "not the optimizer state over data)")
     use_gspmd = (
-        want_gspmd and mesh is not None and not cfg.evaluate
+        (want_gspmd or use_tp) and mesh is not None and not cfg.evaluate
         and not use_zero1
     )
     if want_gspmd and not use_gspmd and verbose:
@@ -301,25 +369,50 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         if verbose:
             print("=> ZeRO-1 optimizer-state sharding over the data axis")
     elif use_gspmd:
-        # single-program GSPMD/pjit path: params replicated (dp_specs),
-        # batch sharded P("data") — the partitioner derives the gradient
-        # all-reduce. Same batch layout shard_host_batch already
-        # produces, so loaders/eval/checkpoint are unchanged.
+        # single-program GSPMD/pjit path: shardings annotated on jit, the
+        # partitioner derives every collective (gradient all-reduce over
+        # data; under TP, one all-reduce per MLP/attention block over
+        # model). Batch stays P("data") — the layout shard_host_batch
+        # already produces — so loaders are unchanged.
         from dptpu.parallel.gspmd import (
             dp_specs,
             make_gspmd_train_step,
             shard_gspmd_state,
+            tp_specs_for_arch,
         )
 
-        specs = dp_specs(state.params)
+        if use_tp:
+            rule, specs = tp_specs_for_arch(cfg.arch, state.params)
+            if verbose:
+                if rule == "dp_specs":
+                    print(
+                        f"=> DPTPU_TP={tp_n}: no tensor-parallel rule for "
+                        f"'{cfg.arch}' (TP ships for vit_*/swin*; CNNs and "
+                        f"MaxViT keep the data axis — see dp_specs "
+                        f"docstring) — running GSPMD data parallelism over "
+                        f"all {int(mesh.shape['data'])} devices instead"
+                    )
+                else:
+                    print(
+                        f"=> tensor parallelism: {rule} over model axis of "
+                        f"{tp_n} × data axis of {int(mesh.shape['data'])}"
+                    )
+        else:
+            rule, specs = "dp_specs", dp_specs(state.params)
+            if verbose:
+                print("=> GSPMD single-program data parallelism (dp_specs)")
         train_step = make_gspmd_train_step(
             mesh, state, specs, compute_dtype, lr_schedule=schedule,
             seed=cfg.seed if cfg.seed is not None else 0,
         )
         state = shard_gspmd_state(state, mesh, specs)
-        eval_view = lambda s: s  # noqa: E731
-        if verbose:
-            print("=> GSPMD single-program data parallelism (dp_specs)")
+        if rule == "dp_specs":
+            eval_view = lambda s: s  # noqa: E731
+        else:
+            # TP-sharded params: one all-gather per validation pass /
+            # checkpoint write (the ZeRO-1 discipline) so the replicated-
+            # spec eval step and the checkpoint writer see full leaves
+            eval_view = lambda s: gather_state(s, mesh)  # noqa: E731
     else:
         train_step = make_train_step(
             mesh, compute_dtype, lr_schedule=schedule,

@@ -82,6 +82,80 @@ def test_fit_zero1_matches_ddp(tiny_imagenet, tmp_path, monkeypatch):
     )
 
 
+def test_fit_tp_matches_single_device(tiny_imagenet, tmp_path, monkeypatch):
+    """DPTPU_TP=4 through the full fit() path: the {data: 2, model: 4}
+    mesh trains a ViT with head-aligned Megatron TP (vit_tp_specs) and
+    must track the single-device run loss-for-loss — the library parity
+    of tests/test_gspmd.py, but THROUGH the trainer: config → mesh →
+    spec selection → sharded state → epoch loop → gathered checkpoint."""
+    from jax.sharding import PartitionSpec as P
+
+    monkeypatch.chdir(tmp_path)
+    cfg = Config(
+        data=tiny_imagenet,
+        arch="vit_b_32",
+        epochs=2,
+        batch_size=24,
+        lr=0.02,
+        workers=2,
+        print_freq=1,
+        seed=1,
+    )
+    single = fit(cfg.replace(gpu=0), image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_TP", "4")
+    tp = fit(cfg, image_size=32, verbose=False)
+    for hs, ht in zip(single["history"], tp["history"]):
+        assert ht["train_loss"] == pytest.approx(hs["train_loss"], rel=1e-3)
+        assert ht["val_loss"] == pytest.approx(hs["val_loss"], rel=1e-3)
+    # the trainer's state is PHYSICALLY tensor-parallel: the head-major
+    # fused qkv and both MLP kernels live sharded over the model axis
+    layer = tp["state"].params["encoder"]["encoder_layer_0"]
+    assert layer["self_attention"]["in_proj"]["kernel"].sharding.spec == P(
+        None, "model"
+    )
+    assert layer["mlp_1"]["kernel"].sharding.spec == P(None, "model")
+    assert layer["mlp_2"]["kernel"].sharding.spec == P("model", None)
+
+    # the per-epoch checkpoint was written from the GATHERED view: it
+    # round-trips into a plain (non-TP) evaluate-only run
+    monkeypatch.delenv("DPTPU_TP")
+    cfg_eval = cfg.replace(resume="checkpoint.pth.tar", evaluate=True)
+    eval_result = fit(cfg_eval, image_size=32, verbose=False)
+    assert eval_result["val"]["loss"] == pytest.approx(
+        tp["history"][-1]["val_loss"], rel=1e-5
+    )
+
+
+def test_fit_tp_fallback_and_precedence_notices(tiny_imagenet, tmp_path,
+                                                monkeypatch, capsys):
+    """DPTPU_TP on a CNN arch falls back to dp_specs over the FLAT
+    full-width data mesh with a notice (no conv TP by design; a
+    factored mesh would waste the model-axis devices on redundant
+    compute), and DPTPU_TP wins over DPTPU_ZERO1 with a notice — both
+    paths still train to a finite loss."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("DPTPU_TP", "2")
+    monkeypatch.setenv("DPTPU_ZERO1", "1")
+    cfg = Config(
+        data=tiny_imagenet,
+        arch="resnet18",
+        epochs=1,
+        batch_size=24,
+        lr=0.02,
+        workers=2,
+        print_freq=1,
+        seed=1,
+    )
+    result = fit(cfg, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    assert np.isfinite(result["history"][0]["train_loss"])
+    out = capsys.readouterr().out
+    assert "DPTPU_ZERO1 ignored: DPTPU_TP drives the GSPMD" in out
+    assert "no tensor-parallel rule for 'resnet18'" in out
+    # the fallback keeps the FULL device count on the data axis
+    assert "over all 8 devices" in out
+
+
 def test_fit_gspmd_flag_trains_and_yields_to_zero1(tiny_imagenet, tmp_path,
                                                    monkeypatch, capsys):
     """DPTPU_GSPMD=1 routes fit() through the single-program pjit step
