@@ -94,7 +94,7 @@ class SelfAttention(nn.Module):
     seq_mode: str = "ulysses"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv_mask=None):
         h = x.shape[-1]
         hd = h // self.heads
         dense = partial(
@@ -108,7 +108,7 @@ class SelfAttention(nn.Module):
         qkv = qkv.reshape(qkv.shape[:-1] + (self.heads, 3, hd))
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         y = sequence_parallel_attention(
-            q, k, v, self.seq_axis_name, self.seq_mode
+            q, k, v, self.seq_axis_name, self.seq_mode, kv_mask=kv_mask
         )
         y = y.reshape(y.shape[:-2] + (h,))
         return dense(
@@ -128,7 +128,7 @@ class EncoderLayer(nn.Module):
     seq_mode: str = "ulysses"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv_mask=None):
         ln = partial(
             nn.LayerNorm, epsilon=1e-6, dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -143,7 +143,7 @@ class EncoderLayer(nn.Module):
             heads=self.heads, dtype=self.dtype,
             param_dtype=self.param_dtype, name="self_attention",
             seq_axis_name=self.seq_axis_name, seq_mode=self.seq_mode,
-        )(y)
+        )(y, kv_mask=kv_mask)
         x = x + y
         y = ln(name="ln_2")(x)
         y = dense(self.mlp_dim, name="mlp_1")(y)
@@ -153,6 +153,23 @@ class EncoderLayer(nn.Module):
 
 
 class Encoder(nn.Module):
+    """``seq_shard_tokens=False`` (default): tokens arrive however the
+    caller laid them out — replicated on one device, or already
+    token-sharded under a hand-written ``shard_map`` whose specs also
+    shard ``pos_embedding``'s axis 1 (the library-level recipe,
+    tests/test_sequence_parallel.py).
+
+    ``seq_shard_tokens=True`` (the trainer's ``DPTPU_SP`` path —
+    requires ``seq_axis_name``): tokens arrive REPLICATED over the
+    sequence axis; the encoder adds the (replicated, exact) position
+    embedding, right-pads the token axis to a multiple of the axis
+    size, slices this device's chunk, and runs the layers
+    sequence-parallel with a key-validity mask so padding never enters
+    a softmax. Returns the LOCAL post-LN chunk — the caller recovers
+    global tokens (VisionTransformer psums the device-0 cls row). No
+    param is sharded, so state creation, checkpointing and eval reuse
+    the plain replicated layout untouched."""
+
     layers: int
     heads: int
     mlp_dim: int
@@ -160,6 +177,7 @@ class Encoder(nn.Module):
     param_dtype: Any
     seq_axis_name: Optional[str] = None
     seq_mode: str = "ulysses"
+    seq_shard_tokens: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -168,12 +186,25 @@ class Encoder(nn.Module):
             (1, x.shape[1], x.shape[2]), jnp.float32,
         )
         x = x + pos.astype(x.dtype)
+        kv_mask = None
+        if self.seq_shard_tokens:
+            from jax import lax
+
+            if self.seq_axis_name is None:
+                raise ValueError("seq_shard_tokens needs seq_axis_name")
+            n = lax.axis_size(self.seq_axis_name)
+            s_tot = x.shape[1]
+            chunk = -(-s_tot // n)  # ceil: pad S+1 up to a multiple of n
+            x = jnp.pad(x, ((0, 0), (0, chunk * n - s_tot), (0, 0)))
+            idx = lax.axis_index(self.seq_axis_name)
+            x = lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=1)
+            kv_mask = (idx * chunk + jnp.arange(chunk)) < s_tot
         for i in range(self.layers):
             x = EncoderLayer(
                 heads=self.heads, mlp_dim=self.mlp_dim, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"encoder_layer_{i}",
                 seq_axis_name=self.seq_axis_name, seq_mode=self.seq_mode,
-            )(x)
+            )(x, kv_mask=kv_mask)
         return nn.LayerNorm(
             epsilon=1e-6, dtype=self.dtype, param_dtype=self.param_dtype,
             name="ln",
@@ -189,6 +220,7 @@ class VisionTransformer(nn.Module):
     bn_dtype: Any = None  # likewise
     seq_axis_name: Optional[str] = None  # sequence parallelism (see above)
     seq_mode: str = "ulysses"
+    seq_shard_tokens: bool = False  # trainer path: see Encoder docstring
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -217,14 +249,27 @@ class VisionTransformer(nn.Module):
             layers=layers, heads=heads, mlp_dim=mlp, dtype=self.dtype,
             param_dtype=self.param_dtype, name="encoder",
             seq_axis_name=self.seq_axis_name, seq_mode=self.seq_mode,
+            seq_shard_tokens=self.seq_shard_tokens,
         )(x)
+        if self.seq_shard_tokens:
+            # x is this device's LOCAL post-LN chunk; the cls token is
+            # row 0 of sequence-rank 0's chunk — zero it elsewhere and
+            # one psum replicates it, so the head (and loss) compute
+            # identically on every sequence member
+            from jax import lax
+
+            idx = lax.axis_index(self.seq_axis_name)
+            cls_tok = jnp.where(idx == 0, x[:, 0], jnp.zeros_like(x[:, 0]))
+            pooled = lax.psum(cls_tok, self.seq_axis_name)
+        else:
+            pooled = x[:, 0]
         return nn.Dense(
             self.num_classes,
             dtype=self.dtype, param_dtype=self.param_dtype,
             kernel_init=nn.initializers.zeros,
             bias_init=nn.initializers.zeros,
             name="head",
-        )(x[:, 0])
+        )(pooled)
 
 
 register_variants(VisionTransformer, "vit", _VARIANTS)

@@ -136,18 +136,86 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         from dptpu.parallel.gspmd import tp_rule_for_arch
 
         tp_fallback = tp_rule_for_arch(cfg.arch) == "dp_specs"
-    if use_tp and not tp_fallback and jax.device_count() % tp_n != 0:
+    if tp_fallback:
+        # demote the TP request entirely: with no rule for this arch
+        # there is nothing for a model axis to do, so later precedence
+        # checks (DPTPU_ZERO1 etc.) must not see an inert TP claim
+        if verbose:
+            print(
+                f"=> DPTPU_TP={tp_n}: no tensor-parallel rule for "
+                f"'{cfg.arch}' (TP ships for vit_*/swin*; CNNs and "
+                f"MaxViT keep the data axis — see dp_specs docstring) — "
+                f"running data parallelism over all "
+                f"{jax.device_count()} devices instead"
+            )
+        use_tp = False
+    if use_tp and jax.device_count() % tp_n != 0:
         raise ValueError(
             f"DPTPU_TP={tp_n} does not divide the {jax.device_count()} "
             f"available devices — pick a divisor so the "
             f"{{data, model}} mesh factors"
         )
+    # DPTPU_SP=N: sequence/context parallelism — a {data, seq: N} mesh,
+    # the ViT token axis sharded over the inner seq axis with Ulysses or
+    # ring attention (DPTPU_SP_MODE, default ulysses). ViT-only: Swin's
+    # windowed attention is already local and parallelizes spatially via
+    # the data axis (README); CNNs have no token axis at all.
+    import os as _os_sp
+
+    sp_n = _os_environ_int("DPTPU_SP")
+    if sp_n < 0:
+        raise ValueError(
+            f"DPTPU_SP={sp_n} must be a positive seq-axis size "
+            f"(e.g. DPTPU_SP=2)"
+        )
+    sp_mode = _os_sp.environ.get("DPTPU_SP_MODE", "ulysses")
+    if sp_n > 1 and sp_mode not in ("ulysses", "ring"):
+        raise ValueError(
+            f"DPTPU_SP_MODE={sp_mode!r} must be 'ulysses' or 'ring'"
+        )
+    if sp_n == 1 and verbose:
+        print("=> DPTPU_SP=1 is a no-op: a one-way seq axis is just "
+              "data parallelism")
+    use_sp = (
+        sp_n > 1 and not single_device and not cfg.evaluate and not use_tp
+    )
+    if sp_n > 1 and not use_sp and verbose:
+        why = (
+            "DPTPU_TP takes precedence (TP x SP composition is not "
+            "implemented)"
+            if use_tp
+            else "--evaluate does not train"
+            if cfg.evaluate and not single_device
+            else "single-device run (no mesh to open a seq axis on)"
+        )
+        print(f"=> DPTPU_SP ignored: {why}")
+    if use_sp and not cfg.arch.startswith("vit_"):
+        if verbose:
+            print(
+                f"=> DPTPU_SP={sp_n}: no sequence-parallel path for "
+                f"'{cfg.arch}' (global-attention ViTs only; Swin windows "
+                f"are spatially local, CNNs have no token axis) — "
+                f"running plain data parallelism over all "
+                f"{jax.device_count()} devices instead"
+            )
+        use_sp = False
+    if use_sp and jax.device_count() % sp_n != 0:
+        raise ValueError(
+            f"DPTPU_SP={sp_n} does not divide the {jax.device_count()} "
+            f"available devices — pick a divisor so the "
+            f"{{data, seq}} mesh factors"
+        )
     if single_device:
         mesh = None
-    elif use_tp and not tp_fallback:
+    elif use_tp:
         from dptpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
         mesh = make_mesh(mesh_shape={DATA_AXIS: -1, MODEL_AXIS: tp_n})
+    elif use_sp:
+        from dptpu.parallel.mesh import DATA_AXIS
+        from dptpu.parallel.sequence import SEQ_AXIS
+
+        mesh = make_mesh(mesh_shape={DATA_AXIS: -1, SEQ_AXIS: sp_n})
     else:
         mesh = make_mesh()
     if cfg.multiprocessing_distributed and verbose:
@@ -247,19 +315,27 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     want_zero1 = _os_environ_flag("DPTPU_ZERO1")  # read once; the ZeRO-1
     # block below reuses this so the precedence rule has one source.
     # Precedence: DPTPU_TP (an explicit topology request — the mesh was
-    # already factored for it) > DPTPU_ZERO1 > DPTPU_GSPMD.
+    # already factored for it) > DPTPU_SP > DPTPU_ZERO1 > DPTPU_GSPMD.
     use_zero1 = (
         want_zero1 and mesh is not None and not cfg.evaluate and not use_tp
+        and not use_sp
     )
     if want_zero1 and use_tp and verbose:
         print("=> DPTPU_ZERO1 ignored: DPTPU_TP drives the GSPMD "
               "tensor-parallel step (params shard over the model axis, "
               "not the optimizer state over data)")
+    elif want_zero1 and use_sp and verbose:
+        print("=> DPTPU_ZERO1 ignored: DPTPU_SP drives the "
+              "sequence-parallel step")
     use_gspmd = (
-        (want_gspmd or use_tp) and mesh is not None and not cfg.evaluate
-        and not use_zero1
+        (want_gspmd or use_tp or tp_fallback)
+        and mesh is not None and not cfg.evaluate
+        and not use_zero1 and not use_sp
     )
-    if want_gspmd and not use_gspmd and verbose:
+    if want_gspmd and use_sp and verbose:
+        print("=> DPTPU_GSPMD ignored: DPTPU_SP drives the "
+              "sequence-parallel step")
+    if want_gspmd and not use_gspmd and not use_sp and verbose:
         # name ZeRO-1 as the reason only when ZeRO-1 will actually run
         why = (
             "DPTPU_ZERO1 takes precedence"
@@ -382,21 +458,14 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         )
 
         if use_tp:
+            # a demoted (no-rule) TP request never reaches here — the
+            # fallback cleared use_tp at mesh time, so the rule is real
             rule, specs = tp_specs_for_arch(cfg.arch, state.params)
             if verbose:
-                if rule == "dp_specs":
-                    print(
-                        f"=> DPTPU_TP={tp_n}: no tensor-parallel rule for "
-                        f"'{cfg.arch}' (TP ships for vit_*/swin*; CNNs and "
-                        f"MaxViT keep the data axis — see dp_specs "
-                        f"docstring) — running GSPMD data parallelism over "
-                        f"all {int(mesh.shape['data'])} devices instead"
-                    )
-                else:
-                    print(
-                        f"=> tensor parallelism: {rule} over model axis of "
-                        f"{tp_n} × data axis of {int(mesh.shape['data'])}"
-                    )
+                print(
+                    f"=> tensor parallelism: {rule} over model axis of "
+                    f"{tp_n} × data axis of {int(mesh.shape['data'])}"
+                )
         else:
             rule, specs = "dp_specs", dp_specs(state.params)
             if verbose:
@@ -413,6 +482,33 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             # checkpoint write (the ZeRO-1 discipline) so the replicated-
             # spec eval step and the checkpoint writer see full leaves
             eval_view = lambda s: gather_state(s, mesh)  # noqa: E731
+    elif use_sp:
+        # sequence-parallel step: token axis over the inner seq axis,
+        # batch over data. Params stay replicated (no sharded state, no
+        # gather needed) — the SAME TrainState trains here and evals
+        # through the standard replicated eval step below. The step's
+        # model is a second ViT instance with the seq flags on; its
+        # param tree is identical (the flags add no params).
+        from dptpu.parallel.sequence import SEQ_AXIS, make_seq_train_step
+
+        seq_model = create_model(
+            cfg.arch,
+            num_classes=num_classes,
+            dtype=compute_dtype,
+            seq_axis_name=SEQ_AXIS,
+            seq_mode=sp_mode,
+            seq_shard_tokens=True,
+        )
+        train_step = make_seq_train_step(
+            mesh, seq_model, compute_dtype, lr_schedule=schedule
+        )
+        eval_view = lambda s: s  # noqa: E731
+        if verbose:
+            print(
+                f"=> sequence parallelism: {sp_mode} attention over seq "
+                f"axis of {sp_n} × data axis of {int(mesh.shape['data'])} "
+                f"(tokens pad to multiples of {sp_n}; cls psum-recovered)"
+            )
     else:
         train_step = make_train_step(
             mesh, compute_dtype, lr_schedule=schedule,

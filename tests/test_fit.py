@@ -128,14 +128,12 @@ def test_fit_tp_matches_single_device(tiny_imagenet, tmp_path, monkeypatch):
 
 def test_fit_tp_fallback_and_precedence_notices(tiny_imagenet, tmp_path,
                                                 monkeypatch, capsys):
-    """DPTPU_TP on a CNN arch falls back to dp_specs over the FLAT
-    full-width data mesh with a notice (no conv TP by design; a
-    factored mesh would waste the model-axis devices on redundant
-    compute), and DPTPU_TP wins over DPTPU_ZERO1 with a notice — both
-    paths still train to a finite loss."""
+    """DPTPU_TP on a CNN arch is DEMOTED with a notice (no conv TP by
+    design): the run keeps the flat full-width data mesh, and — unlike
+    an active TP request — the inert request does not suppress
+    DPTPU_ZERO1, which takes over as usual."""
     monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("DPTPU_TP", "2")
-    monkeypatch.setenv("DPTPU_ZERO1", "1")
     cfg = Config(
         data=tiny_imagenet,
         arch="resnet18",
@@ -150,10 +148,86 @@ def test_fit_tp_fallback_and_precedence_notices(tiny_imagenet, tmp_path,
     assert result["epochs_run"] == 1
     assert np.isfinite(result["history"][0]["train_loss"])
     out = capsys.readouterr().out
-    assert "DPTPU_ZERO1 ignored: DPTPU_TP drives the GSPMD" in out
     assert "no tensor-parallel rule for 'resnet18'" in out
-    # the fallback keeps the FULL device count on the data axis
+    # the fallback keeps the FULL device count on the data axis...
     assert "over all 8 devices" in out
+    # ...and routes through the GSPMD dp step
+    assert "GSPMD single-program data parallelism" in out
+
+    # the demoted request must NOT suppress ZeRO-1 (it would on a real
+    # TP run — that precedence is locked in the SP notices test)
+    monkeypatch.setenv("DPTPU_ZERO1", "1")
+    result = fit(cfg, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    out = capsys.readouterr().out
+    assert "no tensor-parallel rule for 'resnet18'" in out
+    assert "ZeRO-1 optimizer-state sharding" in out
+    assert "DPTPU_ZERO1 ignored" not in out
+
+
+@pytest.mark.parametrize("mode", ["ulysses", "ring"])
+def test_fit_sp_matches_single_device(tiny_imagenet, tmp_path, monkeypatch,
+                                      mode):
+    """DPTPU_SP=4 through the full fit() path: the {data: 2, seq: 4}
+    mesh trains a ViT sequence-parallel (5 tokens pad to 8, key-mask
+    keeps padding out of every softmax, cls psum-recovered) and must
+    track the single-device run loss-for-loss — no hand-written
+    shard_map, no pos-embedding surgery."""
+    monkeypatch.chdir(tmp_path)
+    cfg = Config(
+        data=tiny_imagenet,
+        arch="vit_b_32",
+        epochs=1,
+        batch_size=24,
+        lr=0.02,
+        workers=2,
+        print_freq=1,
+        seed=1,
+    )
+    single = fit(cfg.replace(gpu=0), image_size=64, verbose=False)
+    monkeypatch.setenv("DPTPU_SP", "4")
+    monkeypatch.setenv("DPTPU_SP_MODE", mode)
+    sp = fit(cfg, image_size=64, verbose=False)
+    for hs, hp in zip(single["history"], sp["history"]):
+        assert hp["train_loss"] == pytest.approx(hs["train_loss"], rel=1e-3)
+        assert hp["val_loss"] == pytest.approx(hs["val_loss"], rel=1e-3)
+
+
+def test_fit_sp_fallback_and_precedence_notices(tiny_imagenet, tmp_path,
+                                                monkeypatch, capsys):
+    """DPTPU_SP on a non-ViT arch falls back to plain data parallelism
+    over the flat mesh with a notice, and DPTPU_TP takes precedence
+    over DPTPU_SP with a notice."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("DPTPU_SP", "2")
+    cfg = Config(
+        data=tiny_imagenet,
+        arch="resnet18",
+        epochs=1,
+        batch_size=24,
+        lr=0.02,
+        workers=2,
+        print_freq=1,
+        seed=1,
+    )
+    result = fit(cfg, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    assert np.isfinite(result["history"][0]["train_loss"])
+    out = capsys.readouterr().out
+    assert "no sequence-parallel path for 'resnet18'" in out
+    assert "over all 8 devices" in out
+
+    # TP > SP and TP > ZeRO-1 precedence (vit arch: TP is REAL here, so
+    # unlike the CNN demotion above it suppresses both with notices)
+    monkeypatch.setenv("DPTPU_TP", "2")
+    monkeypatch.setenv("DPTPU_ZERO1", "1")
+    cfg_vit = cfg.replace(arch="vit_b_32")
+    result = fit(cfg_vit, image_size=32, verbose=True)
+    assert result["epochs_run"] == 1
+    out = capsys.readouterr().out
+    assert "DPTPU_SP ignored: DPTPU_TP takes precedence" in out
+    assert "DPTPU_ZERO1 ignored: DPTPU_TP drives the GSPMD" in out
+    assert "tensor parallelism: vit_tp_specs" in out
 
 
 def test_fit_gspmd_flag_trains_and_yields_to_zero1(tiny_imagenet, tmp_path,
