@@ -451,14 +451,17 @@ def _to_torch(arr: np.ndarray, kind) -> np.ndarray:
 
 
 def convert_state_dict(arch: str, state_dict: Dict[str, np.ndarray],
-                       template_variables):
+                       template_variables, kmap=None):
     """torch-keyed arrays -> dptpu ``{"params", "batch_stats"}`` variables.
 
     ``template_variables`` (from ``model.init``) fixes the tree structure
     and validates shapes. Raises on missing or mismatched keys so a wrong
-    checkpoint fails loudly rather than half-loading.
+    checkpoint fails loudly rather than half-loading. ``kmap`` accepts a
+    precomputed ``torch_key_map(arch, template_variables)`` so callers
+    that already built one (train/checkpoint.py) skip the rebuild.
     """
-    kmap = torch_key_map(arch, template_variables)
+    if kmap is None:
+        kmap = torch_key_map(arch, template_variables)
     out = {"params": {}, "batch_stats": {}}
 
     def set_path(tree, names, value):

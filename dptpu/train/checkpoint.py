@@ -180,40 +180,53 @@ def _load_torch_checkpoint(path: str, state, arch: Optional[str],
             "was passed — cannot build the key map"
         )
     raw_sd = ckpt["state_dict"]
+    template = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+    }
+    kmap = torch_key_map(arch, template)
     sd = {}
-    param_keys = []  # state-dict order minus buffers == parameters() order
+    # torch parameters() order == state-dict key order restricted to
+    # keys the map resolves into the 'params' collection — this excludes
+    # EVERY registered buffer generically (BN running stats/bookkeeping,
+    # Swin's relative_position_index/attn_mask, ...), not just the BN
+    # suffixes, so the param-index mapping below cannot desync on archs
+    # with exotic buffers
+    param_keys = []
     for k, v in raw_sd.items():
         k = k[len("module."):] if k.startswith("module.") else k
         if k.endswith("num_batches_tracked"):
             continue  # torch BN bookkeeping; no dptpu equivalent
         sd[k] = v.detach().cpu().numpy()
-        if not k.endswith(("running_mean", "running_var")):
+        if k in kmap and kmap[k][0] == "params":
             param_keys.append(k)
-    template = {
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats),
-    }
-    variables = convert_state_dict(arch, sd, template)
+    variables = convert_state_dict(arch, sd, template, kmap=kmap)
 
     # SGD momentum: torch keys state entries by global param index in
-    # param_groups order — identical to parameters() order, which is the
-    # state-dict key order with buffers filtered out (param_keys above)
-    kmap = torch_key_map(arch, template)
+    # param_groups order — identical to parameters() order (param_keys)
     opt_sd = ckpt.get("optimizer") or {}
     indices = [
         i for g in opt_sd.get("param_groups", []) for i in g["params"]
     ]
+    if indices and len(indices) != len(param_keys):
+        # a silent skip here would partially restore momentum after a
+        # desync; refuse loudly instead
+        raise ValueError(
+            f"{path}: torch optimizer tracks {len(indices)} params but "
+            f"the key map resolves {len(param_keys)} trainable keys for "
+            f"'{arch}' — the param-index mapping would desync, so "
+            f"momentum cannot be restored safely"
+        )
     torch_state = opt_sd.get("state", {})
     buffers = {}
     for pos, idx in enumerate(indices):
         buf = torch_state.get(idx, {}).get("momentum_buffer")
-        if buf is None or pos >= len(param_keys):
-            continue
+        if buf is None:
+            continue  # torch SGD momentum starts lazily per-param
         collection, names, kind = kmap[param_keys[pos]]
-        if collection == "params":
-            buffers[names] = _from_torch(
-                buf.detach().cpu().numpy(), kind
-            ).astype(np.float32)
+        buffers[names] = _from_torch(
+            buf.detach().cpu().numpy(), kind
+        ).astype(np.float32)
     flat, treedef = jax.tree_util.tree_flatten_with_path(
         variables["params"]
     )

@@ -250,3 +250,66 @@ def test_fit_resumes_reference_torch_checkpoint(tiny_imagenet, tmp_path,
     result = fit(cfg, image_size=32, verbose=False)
     assert result["epochs_run"] == 1  # epochs(3) - resume epoch(2)
     assert np.isfinite(result["history"][0]["train_loss"])
+
+
+def test_torch_checkpoint_swin_buffers_do_not_desync_momentum(tmp_path):
+    """Archs with non-BN registered buffers (Swin's
+    relative_position_index / attn_mask live in the torch state dict but
+    are NOT parameters) must still restore momentum exactly: param-index
+    mapping is built from the key map's 'params' collection, not from
+    suffix filtering, so interleaved buffer keys cannot shift it."""
+    state = _fresh_state(arch="swin_t", image=32)
+    path = str(tmp_path / "checkpoint.pth.tar")
+    want = _synthetic_torch_checkpoint(state, "swin_t", path)
+
+    # rewrite the file with torch-realistic buffer keys INTERLEAVED
+    # between the params (position matters for the old suffix-based
+    # filter, which would have counted them as params and desynced)
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = ckpt["state_dict"]
+    new_sd = {}
+    for k, v in sd.items():
+        new_sd[k] = v
+        if k.endswith("attn.qkv.weight"):
+            base = k[: -len("qkv.weight")]
+            new_sd[base + "relative_position_index"] = torch.zeros(
+                (49, 49), dtype=torch.long
+            )
+            new_sd[base + "attn_mask"] = torch.zeros((4, 49, 49))
+    ckpt["state_dict"] = new_sd
+    torch.save(ckpt, path)
+
+    loaded, meta = load_checkpoint(path, state, steps_per_epoch=5)
+    assert meta["arch"] == "swin_t"
+    # every momentum buffer landed on ITS param (exact round trip)
+    import optax
+
+    for node in jax.tree_util.tree_leaves(
+        loaded.opt_state, is_leaf=lambda n: isinstance(n, optax.TraceState)
+    ):
+        if isinstance(node, optax.TraceState):
+            flat = jax.tree_util.tree_flatten_with_path(node.trace)[0]
+            for pth, leaf in flat:
+                names = tuple(p.key for p in pth)
+                np.testing.assert_array_equal(
+                    np.asarray(leaf), want["momentum"][names], err_msg=str(names)
+                )
+            break
+    else:  # pragma: no cover
+        raise AssertionError("no TraceState in opt_state")
+
+
+def test_torch_checkpoint_param_count_desync_refused(tmp_path):
+    """An optimizer whose param_groups track a different param count
+    than the key map resolves must REFUSE to restore momentum (raise),
+    never partially restore it in silence."""
+    state = _fresh_state()
+    path = str(tmp_path / "checkpoint.pth.tar")
+    _synthetic_torch_checkpoint(state, "resnet18", path)
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    ckpt["optimizer"]["param_groups"][0]["params"] = (
+        ckpt["optimizer"]["param_groups"][0]["params"][:-1]
+    )
+    torch.save(ckpt, path)
+    with pytest.raises(ValueError, match="desync"):
+        load_checkpoint(path, state, steps_per_epoch=5)

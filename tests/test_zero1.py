@@ -74,10 +74,13 @@ def test_specs_shard_largest_divisible_dim(eight_devices):
 
 
 def test_sharded_fraction_covers_cnn_and_vit_zoo(eight_devices):
-    """The headline memory claim, asserted: >=90% of params+momentum
-    BYTES shard 1/N for BOTH a conv net (HWIO kernels — dim 0 is kernel
-    height, which a dim-0-only rule misses almost entirely) and a ViT.
-    Shapes come from jax.eval_shape: no weights are allocated."""
+    """The headline memory claim, asserted AT the documented bound
+    (PARALLELISM.md / zero.py: ">=99% of bytes shard 1/N"): for BOTH a
+    conv net (HWIO kernels — dim 0 is kernel height, which a dim-0-only
+    rule misses almost entirely) and a ViT. Measured 100.0% for both on
+    an 8-wide axis; the bound is 0.99 so the docs can never silently
+    drift above what the suite enforces. Shapes come from
+    jax.eval_shape: no weights are allocated."""
     import optax
 
     from dptpu.models import create_model
@@ -94,7 +97,7 @@ def test_sharded_fraction_covers_cnn_and_vit_zoo(eight_devices):
             )
         )
         frac = zero1_sharded_fraction(shapes, mesh)
-        assert frac >= 0.90, f"{name}: only {frac:.1%} of bytes shard"
+        assert frac >= 0.99, f"{name}: only {frac:.1%} of bytes shard"
 
 
 def test_zero1_state_is_physically_sharded(eight_devices):
