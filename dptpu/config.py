@@ -71,8 +71,11 @@ class Config:
     seed: Optional[int] = None
     gpu: Optional[int] = None
     multiprocessing_distributed: bool = False
-    # apex extras (imagenet_ddp_apex.py:88-95)
-    local_rank: int = 0
+    # apex extras (imagenet_ddp_apex.py:88-95). local_rank's REFERENCE
+    # default is 0; None here just distinguishes "not passed" so the
+    # accepted-and-mapped notice can fire even for an explicit 0 (the
+    # launcher's first worker) — behavior is identical either way.
+    local_rank: Optional[int] = None
     sync_bn: bool = False
     opt_level: Optional[str] = None
     keep_batchnorm_fp32: Optional[str] = None
@@ -156,7 +159,7 @@ def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentPar
                        help="device id to pin (single-device mode)")
         p.add_argument("--multiprocessing-distributed", action="store_true")
     if variant == "apex":
-        p.add_argument("--local_rank", default=0, type=int)
+        p.add_argument("--local_rank", default=None, type=int)
         p.add_argument("--sync-bn", action="store_true",
                        help="cross-replica BatchNorm statistics")
         p.add_argument("--opt-level", type=str, default=None,

@@ -39,14 +39,30 @@ def _resolve_rendezvous(cfg: Config) -> Tuple[Optional[str], int, int]:
     return coordinator, world_size, rank
 
 
+# What this process already rendezvoused as: (coordinator, world, rank).
+# jax.distributed.initialize crashes if called twice, but a second fit()
+# in one process (sweeps, notebooks, tests) is a legitimate pattern — the
+# guard makes a matching re-init a no-op and a conflicting one an error.
+_initialized: Optional[Tuple[str, int, int]] = None
+
+
 def initialize_distributed(cfg: Config) -> bool:
     """Join the multi-host job if the config asks for one.
 
     Returns True when running multi-process. Safe to call in single-host
-    mode (no-op, like the reference's conditional init, nd_imagenet.py:123).
+    mode (no-op, like the reference's conditional init, nd_imagenet.py:123)
+    and safe to call AGAIN with the same rendezvous (no-op — a second
+    ``fit()`` in one process must not crash); a different rendezvous in an
+    already-joined process raises.
     The ``--dist-backend`` flag is accepted but ignored: collectives are
     always XLA's, compiled onto ICI within a slice and DCN across slices.
+    ``DPTPU_RENDEZVOUS_TIMEOUT`` (seconds, default jax's 300) bounds how
+    long this process waits for the others; a timeout raises an
+    actionable error naming the coordinator instead of a bare backend
+    trace (the reference blocks forever on a missing rank,
+    imagenet_ddp.py:104 — a bounded, named failure is strictly kinder).
     """
+    global _initialized
     coordinator, world_size, rank = _resolve_rendezvous(cfg)
     if world_size <= 1:
         return False
@@ -54,9 +70,64 @@ def initialize_distributed(cfg: Config) -> bool:
         raise ValueError(
             "distributed run needs a rank (--rank or RANK env), got -1"
         )
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=world_size,
-        process_id=rank,
-    )
+    if _initialized is not None:
+        if _initialized == (coordinator, world_size, rank):
+            return True  # same job — idempotent re-entry
+        raise RuntimeError(
+            f"this process already joined a distributed job as "
+            f"{_initialized} and cannot re-join as "
+            f"{(coordinator, world_size, rank)} — jax.distributed "
+            f"supports one rendezvous per process; start a new process "
+            f"for a different job"
+        )
+    try:  # private API, best-effort: someone may have initialized jax
+        from jax._src.distributed import global_state as _gs
+
+        externally_initialized = _gs.client is not None
+    except Exception:
+        externally_initialized = False
+    if externally_initialized:
+        # jax.distributed is already up (driver/harness-initialized);
+        # re-calling initialize would crash. Adopt the session ONLY if
+        # the config describes the same world — a silent mismatch would
+        # mis-shard every downstream mesh/batch computation.
+        if (jax.process_count() != world_size
+                or jax.process_index() != rank):
+            raise RuntimeError(
+                f"jax.distributed is already initialized as process "
+                f"{jax.process_index()}/{jax.process_count()}, but the "
+                f"config asks for rank {rank}/{world_size} — fix the "
+                f"--world-size/--rank flags (or WORLD_SIZE/RANK env) to "
+                f"match the live session, or start a new process"
+            )
+        _initialized = (coordinator, world_size, rank)
+        return True
+    timeout_s = os.environ.get("DPTPU_RENDEZVOUS_TIMEOUT")
+    try:
+        kwargs = (
+            {"initialization_timeout": int(timeout_s)} if timeout_s else {}
+        )
+    except ValueError:
+        raise ValueError(
+            f"DPTPU_RENDEZVOUS_TIMEOUT={timeout_s!r} must be a whole "
+            f"number of seconds (e.g. DPTPU_RENDEZVOUS_TIMEOUT=300)"
+        ) from None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size,
+            process_id=rank,
+            **kwargs,
+        )
+    except Exception as e:
+        raise RuntimeError(
+            f"rendezvous failed: rank {rank}/{world_size} could not join "
+            f"the coordinator at {coordinator} "
+            f"({type(e).__name__}: {e}). Check that every rank is "
+            f"launched with the same --dist-url/--world-size, that rank 0 "
+            f"is reachable on that address/port, and that no stale "
+            f"process holds the port (process_cleanup.sh). "
+            f"DPTPU_RENDEZVOUS_TIMEOUT=<seconds> bounds the wait."
+        ) from e
+    _initialized = (coordinator, world_size, rank)
     return True

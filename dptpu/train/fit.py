@@ -229,6 +229,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             "all local chips from one process per host (SPMD mesh); no "
             "worker processes are spawned"
         )
+    if cfg.variant == "apex" and cfg.local_rank is not None and verbose:
+        # accepted-and-mapped, never silent (imagenet_ddp_apex.py:88,
+        # 120-123): the launcher's per-GPU pinning flag has no per-chip
+        # process here — one process per HOST drives every local chip
+        print(
+            f"=> --local_rank {cfg.local_rank} noted: dptpu is one "
+            "process per host (SPMD mesh), so per-device process "
+            "pinning is not needed; all local chips are driven together"
+        )
     put = (
         partial(jax.device_put, device=jax.local_devices()[cfg.gpu or 0])
         if single_device
