@@ -52,6 +52,39 @@ class ImageFolderDataset:
     def __len__(self) -> int:
         return len(self.samples)
 
+    def _native_decode(self, path: str, rng, out=None):
+        """Fused libjpeg decode-crop-resize into ``out`` (or a fresh
+        array); None when this sample/environment can't take the path.
+        Transforms may veto it with ``native_ok = False`` (ValTransform
+        does: the fast path's scaled decode + 2-tap lerp is augmentation
+        -grade, not validation-grade — see its docstring)."""
+        if self.transform is None or not hasattr(self.transform, "sample") \
+                or not getattr(self.transform, "native_ok", True) \
+                or not path.lower().endswith((".jpg", ".jpeg")):
+            return None
+        from dptpu.data import native_image
+
+        if not native_image.available():
+            return None
+        with open(path, "rb") as f:
+            data = f.read()
+        dims = native_image.jpeg_dims(data)
+        if dims is None:
+            return None
+        box, flip = self.transform.sample(dims[0], dims[1], rng)
+        return native_image.decode_crop_resize(
+            data, box, self.transform.size, flip, out=out
+        )
+
+    def _pil_decode(self, path: str, rng):
+        from PIL import Image
+
+        with Image.open(path) as img:
+            img = img.convert("RGB")
+            if self.transform is None:
+                return np.asarray(img)
+            return self.transform(img, rng)
+
     def get(self, index: int, rng: Optional[np.random.Generator] = None):
         """Load + transform one sample; ``rng`` drives any augmentation
         randomness (per-item, loader-provided — see DataLoader).
@@ -65,30 +98,23 @@ class ImageFolderDataset:
         path, label = self.samples[index]
         if rng is None:
             rng = np.random.default_rng(index)
-        if self.transform is not None and hasattr(self.transform, "sample") \
-                and path.lower().endswith((".jpg", ".jpeg")):
-            from dptpu.data import native_image
-
-            if native_image.available():
-                with open(path, "rb") as f:
-                    data = f.read()
-                dims = native_image.jpeg_dims(data)
-                if dims is not None:
-                    box, flip = self.transform.sample(dims[0], dims[1], rng)
-                    out = native_image.decode_crop_resize(
-                        data, box, self.transform.size, flip
-                    )
-                    if out is not None:
-                        return out, label
-        from PIL import Image
-
-        with Image.open(path) as img:
-            img = img.convert("RGB")
-            if self.transform is None:
-                out = np.asarray(img)
-            else:
-                out = self.transform(img, rng)
+        out = self._native_decode(path, rng)
+        if out is None:
+            out = self._pil_decode(path, rng)
         return out, label
+
+    def get_into(self, index: int, rng, out: np.ndarray) -> int:
+        """Decode + transform sample ``index`` DIRECTLY into ``out``
+        (uint8 HWC — typically one row of the loader's preallocated
+        batch) and return the label. The native path writes the pixels
+        in place with zero intermediates; fallbacks copy once."""
+        path, label = self.samples[index]
+        nat = self._native_decode(path, rng, out=out)
+        if nat is None:
+            np.copyto(out, self._pil_decode(path, rng))
+        elif nat is not out:  # non-contiguous out fell back to a fresh array
+            np.copyto(out, nat)
+        return label
 
     def __getitem__(self, index: int):
         return self.get(index)
@@ -121,6 +147,13 @@ class SyntheticDataset:
                 rng if rng is not None else np.random.default_rng(index),
             )
         return img, label
+
+    def get_into(self, index: int, rng, out: np.ndarray) -> int:
+        """Loader fast-path API parity with ImageFolderDataset (one copy
+        into the preallocated batch row; generation dominates anyway)."""
+        img, label = self.get(index, rng)
+        np.copyto(out, img)
+        return label
 
     def __getitem__(self, index: int):
         return self.get(index)

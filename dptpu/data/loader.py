@@ -6,8 +6,13 @@ imagenet_ddp_apex.py:26-39,304-351), rebuilt for the TPU host model:
 
 * decode/transform on a thread pool (PIL/libjpeg release the GIL for the
   heavy work — no process fork needed, unlike torch workers);
-* collate straight into a preallocated uint8 NHWC batch (fast_collate's
-  "no float conversion on CPU" insight — ×4 less H2D traffic);
+* CHUNKED submission, decoded in place: each batch submits one future per
+  worker (not per image), and each worker decodes its span of samples
+  DIRECTLY into the preallocated uint8 NHWC batch (``dataset.get_into`` →
+  the native decoder's caller-supplied output buffer) — fast_collate's
+  "no float conversion on CPU" insight (×4 less H2D traffic) without the
+  per-image future dispatch + intermediate-array memcpy that round 4's
+  HOSTBENCH measured as ~19% of a decode core;
 * keep ``prefetch_batches`` batches in flight so decode overlaps step time;
 * per-item augmentation RNG derived from ``(seed, epoch, sample_index)`` —
   reproducible regardless of thread scheduling (the ``--seed`` contract,
@@ -55,6 +60,8 @@ class DataLoader:
         self.pad_final = pad_final
         self.seed = seed
         self._get = getattr(dataset, "get", None)
+        self._get_into = getattr(dataset, "get_into", None)
+        self._item_shape = None  # probed from the first sample
         self._pool = ThreadPoolExecutor(
             max_workers=self.num_workers, thread_name_prefix="dptpu-data"
         )
@@ -69,17 +76,46 @@ class DataLoader:
         rng = np.random.default_rng([self.seed, epoch, index])
         return self._get(index, rng)
 
-    def _collate(self, futures, valid=None):
-        n_valid = len(futures)
+    def _load_span(self, idxs, epoch, imgs, labels, offset):
+        """Decode a span of samples directly into rows
+        ``offset..offset+len(idxs)`` of the shared batch arrays — the
+        per-worker unit of a chunked submission (disjoint rows, so
+        concurrent spans never race)."""
+        get_into = self._get_into
+        for j, index in enumerate(idxs):
+            index = int(index)
+            if get_into is not None:
+                rng = np.random.default_rng([self.seed, epoch, index])
+                labels[offset + j] = get_into(index, rng, imgs[offset + j])
+            else:
+                img, label = self._load_one(index, epoch)
+                imgs[offset + j] = img
+                labels[offset + j] = label
+
+    def _submit_batch(self, batch_indices, epoch):
+        """Preallocate one batch and fan its samples out as ONE future
+        per worker (each decoding in place via ``_load_span``) — not one
+        per image: HOSTBENCH r4 measured the per-image dispatch +
+        intermediate memcpy at ~19% of a decode core."""
+        n_valid = len(batch_indices)
         out_size = self.batch_size if self.pad_final else n_valid
-        first_img, _ = futures[0].result()
-        batch_imgs = np.empty((out_size,) + first_img.shape, np.uint8)
+        imgs = np.empty((out_size,) + self._item_shape, np.uint8)
         labels = np.zeros((out_size,), np.int32)
-        for i, fut in enumerate(futures):
-            img, label = fut.result()
-            batch_imgs[i] = img
-            labels[i] = label
-        batch = {"images": batch_imgs, "labels": labels}
+        span = -(-n_valid // self.num_workers)
+        futs = [
+            self._pool.submit(
+                self._load_span, batch_indices[o:o + span], epoch,
+                imgs, labels, o,
+            )
+            for o in range(0, n_valid, span)
+        ]
+        return futs, imgs, labels, n_valid
+
+    def _finalize(self, futs, imgs, labels, n_valid, valid=None):
+        for f in futs:
+            f.result()  # wait + propagate decode errors
+        batch = {"images": imgs, "labels": labels}
+        out_size = imgs.shape[0]
         # the eval mask flags positions an exact aggregation must skip:
         # batch-tail padding AND the sampler's wrap-around duplicates
         # (samplers pad shards to equal length, imagenet_ddp.py:175-183).
@@ -90,7 +126,7 @@ class DataLoader:
             self.pad_final and valid is not None and not valid.all()
         )
         if n_valid < out_size:  # pad tail by repeating sample 0
-            batch_imgs[n_valid:] = batch_imgs[0]
+            imgs[n_valid:] = imgs[0]
             labels[n_valid:] = labels[0]
         if need_mask:
             mask = np.zeros((out_size,), np.float32)
@@ -107,23 +143,23 @@ class DataLoader:
         nb = len(self)
         sl = lambda b: slice(b * self.batch_size, (b + 1) * self.batch_size)  # noqa: E731
         chunks = [(indices[sl(b)], valid[sl(b)]) for b in range(nb)]
-
-        def submit(chunk):
-            return [
-                self._pool.submit(self._load_one, int(i), epoch) for i in chunk
-            ]
+        if self._item_shape is None and nb:
+            # one probe decode fixes the item shape for preallocation
+            # (cached on the loader; only the first epoch() call pays)
+            img, _ = self._load_one(int(chunks[0][0][0]), epoch)
+            self._item_shape = np.asarray(img).shape
 
         pending = deque()
         ahead = 1 + max(0, prefetch_batches)
         for chunk, _ in chunks[:ahead]:
-            pending.append(submit(chunk))
+            pending.append(self._submit_batch(chunk, epoch))
         next_idx = ahead
         for b in range(nb):
-            futs = pending.popleft()
+            item = pending.popleft()
             if next_idx < nb:
-                pending.append(submit(chunks[next_idx][0]))
+                pending.append(self._submit_batch(chunks[next_idx][0], epoch))
                 next_idx += 1
-            yield self._collate(futs, valid=chunks[b][1])
+            yield self._finalize(*item, valid=chunks[b][1])
 
     def close(self):
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -32,15 +32,25 @@ def jpeg_dims(data: bytes) -> Optional[Tuple[int, int]]:
     return w.value, h.value
 
 
-def decode_crop_resize(data: bytes, box, out_size: int,
-                       flip: bool) -> Optional[np.ndarray]:
+def decode_crop_resize(data: bytes, box, out_size: int, flip: bool,
+                       out: Optional[np.ndarray] = None
+                       ) -> Optional[np.ndarray]:
     """Decode + crop ``box`` (left, top, w, h in full-res coords) + resize to
-    ``out_size``² RGB (+flip). Returns uint8 HWC array or None on failure."""
+    ``out_size``² RGB (+flip). Returns uint8 HWC array or None on failure.
+
+    ``out`` lets the caller supply the destination (e.g. one row of the
+    loader's preallocated batch) so the decoder writes the pixels in
+    place — no per-image intermediate + memcpy. It must be a C-contiguous
+    uint8 (out_size, out_size, 3) array; anything else falls back to a
+    fresh allocation (the caller can detect that by identity)."""
     lib = load_library()
     if lib is None:
         return None
-    out = np.empty((out_size, out_size, 3), np.uint8)
-    left, top, cw, ch = (int(v) for v in box)
+    if (out is None or out.dtype != np.uint8
+            or out.shape != (out_size, out_size, 3)
+            or not out.flags["C_CONTIGUOUS"]):
+        out = np.empty((out_size, out_size, 3), np.uint8)
+    left, top, cw, ch = (float(v) for v in box)
     rc = lib.dptpu_jpeg_decode_crop_resize(
         data, len(data), left, top, cw, ch, out_size, int(flip),
         out.ctypes.data_as(ctypes.c_void_p),

@@ -135,7 +135,7 @@ def main():
     have_native = native_image.available()
 
     cores = os.cpu_count() or 1
-    results = {"round": 4, "native_available": have_native,
+    results = {"round": 5, "native_available": have_native,
                "jpeg": "500x400 q85",
                "transform": "RandomResizedCrop(224)+flip",
                "host_cpu_count": cores,
@@ -160,10 +160,19 @@ def main():
 
     e2e = bench_loader(os.path.join(tmp, "train"), 8, args.seconds)
     results["loader_e2e_8workers_imgs_per_sec"] = round(e2e, 1)
-    results["loader_e2e_imgs_per_sec_per_core"] = round(
-        e2e / min(8, cores), 1
-    )
-    print(f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s")
+    e2e_per_core = e2e / min(8, cores)
+    results["loader_e2e_imgs_per_sec_per_core"] = round(e2e_per_core, 1)
+    # the loader-overhead verdict: e2e per core over the best raw decode
+    # per core. Round 4 (one future per image + intermediate memcpy)
+    # measured 0.81; the chunked in-place loader's bar is >= 0.9.
+    if best_per_core > 0:
+        results["loader_e2e_fraction_of_raw"] = round(
+            e2e_per_core / best_per_core, 3
+        )
+    print(f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s "
+          f"({e2e_per_core / best_per_core:.2f}x raw decode/core)"
+          if best_per_core else
+          f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s")
 
     # the honest feedability bound: how many host cores one chip needs.
     # per-core decode rate is the scale-free number (thread scaling only
