@@ -53,12 +53,28 @@ def sample_rrc_box(width, height, rng, scale=(0.08, 1.0),
 
 
 def center_fit_box(width, height, size=224, resize=256):
-    """Resize(resize)+CenterCrop(size) as ONE crop box in original
-    coordinates: scale s = resize/min(w,h); the size×size center crop of the
-    scaled image corresponds to a centered (size/s)×(size/s) source box."""
-    crop = min(width, height) * size / float(resize)
-    cw = ch = int(round(crop))
-    return (width - cw) // 2, (height - ch) // 2, cw, ch
+    """Resize(resize)+CenterCrop(size) as ONE (fractional) crop box that is
+    PIXEL-EXACT to torchvision's two-step pipeline.
+
+    torchvision's Resize scales the short edge to ``resize`` and the long
+    edge to ``int(resize * long / short)`` (truncation), then CenterCrop
+    cuts ``size``² at integer offsets of THAT grid — a plain crop, no
+    second resample. A single box-resize reproduces it exactly when the
+    box is the crop rectangle mapped back through each axis's own scale:
+    output coord x spans intermediate [left, left+size), i.e. source
+    [left·W/nw, (left+size)·W/nw) — fractional in general (the long-edge
+    int() makes sx ≠ sy by a hair, and odd margins make left·s
+    fractional). Round 5's A/B (scripts/check_tv_parity.py) measured the
+    previous integer-box approximation at mean |Δpx| up to ~10 on
+    non-integer-scale geometries — a sub-pixel phase shift — so the box
+    is now exact; the A/B locks it at 0."""
+    if width <= height:
+        nw, nh = resize, int(resize * height / width)
+    else:
+        nh, nw = resize, int(resize * width / height)
+    sx, sy = width / float(nw), height / float(nh)
+    left, top = (nw - size) // 2, (nh - size) // 2
+    return left * sx, top * sy, size * sx, size * sy
 
 
 class TrainTransform:
@@ -92,7 +108,19 @@ class TrainTransform:
 
 class ValTransform:
     """Resize(resize) → CenterCrop(size) → uint8 HWC array (PIL applier;
-    accepts and ignores ``rng``)."""
+    accepts and ignores ``rng``).
+
+    ``native_ok = False``: the val pipeline ALWAYS decodes via PIL. The
+    native C fast path trades exactness for speed (libjpeg scaled
+    decode, IFAST DCT, 2-tap fixed-point lerp vs PIL's anti-aliased
+    reduction filter — measured mean |Δpx| ≈ 1.5 on q85 JPEGs), which is
+    fine under training augmentation but not for validation, where the
+    whole point is reproducing torchvision's published-accuracy pixels
+    (the fractional-box math above makes the PIL path two-step-exact).
+    Val is ~4% of an ImageNet epoch's decode volume, so correctness
+    wins."""
+
+    native_ok = False
 
     def __init__(self, size=224, resize=256):
         self.size = size

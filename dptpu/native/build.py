@@ -57,7 +57,9 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.dptpu_jpeg_decode_crop_resize.restype = ctypes.c_int
         lib.dptpu_jpeg_decode_crop_resize.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            # fractional crop box (exact-val-pipeline boxes are floats)
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double,
             ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
         ]
         _cached = lib

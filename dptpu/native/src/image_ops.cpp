@@ -124,14 +124,17 @@ int dptpu_jpeg_dims(const uint8_t* data, size_t size, int* width,
   return 0;
 }
 
-// Decode + crop box (full-resolution coords) + bilinear resize to
-// out_size x out_size RGB + optional horizontal flip, into `out`
-// (out_size*out_size*3 bytes, caller-allocated).
+// Decode + crop box (full-resolution coords; FRACTIONAL boxes allowed —
+// the exact-val-pipeline path expresses Resize(256)+CenterCrop(224) as
+// one fractional box) + bilinear resize to out_size x out_size RGB +
+// optional horizontal flip, into `out` (out_size*out_size*3 bytes,
+// caller-allocated).
 int dptpu_jpeg_decode_crop_resize(const uint8_t* data, size_t size,
-                                  int crop_left, int crop_top, int crop_w,
-                                  int crop_h, int out_size, int flip,
+                                  double crop_left, double crop_top,
+                                  double crop_w, double crop_h,
+                                  int out_size, int flip,
                                   uint8_t* out) {
-  if (crop_w <= 0 || crop_h <= 0 || out_size <= 0) return -3;
+  if (crop_w <= 0.0 || crop_h <= 0.0 || out_size <= 0) return -3;
   jpeg_decompress_struct cinfo;
   ErrorMgr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
@@ -153,7 +156,7 @@ int dptpu_jpeg_decode_crop_resize(const uint8_t* data, size_t size,
   int num = 8;
   while (num > 1) {
     const int cand = num - 1;
-    if (crop_w * cand >= out_size * 8 && crop_h * cand >= out_size * 8) {
+    if (crop_w * cand >= out_size * 8.0 && crop_h * cand >= out_size * 8.0) {
       num = cand;
     } else {
       break;

@@ -197,3 +197,36 @@ def test_device_prefetcher_preserves_order():
     for a, b in zip(direct, fetched):
         np.testing.assert_array_equal(a, b)
     loader.close()
+
+
+def test_val_transform_matches_torchvision_two_step_exactly():
+    """The fused one-box val resample must be PIXEL-EXACT (±1 LSB of
+    uint8 rounding) to torchvision's two-step Resize(256)→CenterCrop(224)
+    across awkward geometries — including non-integer long-edge scales,
+    where the pre-round-5 integer box drifted by a sub-pixel phase
+    (mean |Δpx| up to ~10, scripts/check_tv_parity.py)."""
+    import numpy as np
+    from PIL import Image
+
+    from dptpu.data.transforms import ValTransform
+
+    fused = ValTransform(224, 256)
+    rng = np.random.RandomState(3)
+    for (w, h) in [(500, 400), (640, 480), (1024, 768), (300, 224),
+                   (231, 256), (257, 511)]:
+        low = rng.randint(0, 255, (max(h // 8, 2), max(w // 8, 2), 3),
+                          np.uint8)
+        img = Image.fromarray(low).resize((w, h), Image.BILINEAR)
+        a = fused(img).astype(np.int16)
+        if w <= h:
+            nw, nh = 256, int(256 * h / w)
+        else:
+            nh, nw = 256, int(256 * w / h)
+        resized = img.resize((nw, nh), Image.BILINEAR)
+        left, top = (nw - 224) // 2, (nh - 224) // 2
+        b = np.asarray(
+            resized.crop((left, top, left + 224, top + 224)), np.int16
+        )
+        d = np.abs(a - b)
+        assert d.max() <= 1, (w, h, d.max())
+        assert (d > 0).mean() < 0.02, (w, h, (d > 0).mean())

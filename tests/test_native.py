@@ -98,3 +98,37 @@ def test_dataset_native_path_and_png_fallback(tmp_path):
     assert img_jpg.shape == img_png.shape == (64, 64, 3)
     # same sampled crop on (nearly) identical sources → near-identical output
     assert np.mean(np.abs(img_jpg.astype(int) - img_png.astype(int))) < 4.0
+
+
+def test_val_pipeline_routes_to_exact_pil_path(tmp_path):
+    """The PRODUCTION val path (ImageFolderDataset + ValTransform on a
+    JPEG) must be bit-identical to torchvision's two-step pipeline —
+    i.e. the approximate native fast path (scaled decode + IFAST +
+    2-tap lerp) must NOT engage for validation, only for train
+    augmentation (native_ok veto)."""
+    arr = _smooth_image(500, 400)
+    d = tmp_path / "val" / "c0"
+    d.mkdir(parents=True)
+    Image.fromarray(arr).save(d / "a.jpg", quality=85)
+    ds = ImageFolderDataset(str(tmp_path / "val"), ValTransform(224, 256))
+    got = ds.get(0)[0].astype(np.int16)
+    with Image.open(d / "a.jpg") as img:
+        img = img.convert("RGB")
+        w, h = img.size
+        if w <= h:
+            nw, nh = 256, int(256 * h / w)
+        else:
+            nh, nw = 256, int(256 * w / h)
+        resized = img.resize((nw, nh), Image.BILINEAR)
+        left, top = (nw - 224) // 2, (nh - 224) // 2
+        want = np.asarray(
+            resized.crop((left, top, left + 224, top + 224)), np.int16
+        )
+    d2 = np.abs(got - want)
+    assert d2.max() <= 1 and (d2 > 0).mean() < 0.02, (
+        d2.max(), (d2 > 0).mean()
+    )
+    # the in-place loader path routes identically
+    out = np.empty((224, 224, 3), np.uint8)
+    ds.get_into(0, np.random.default_rng(0), out)
+    np.testing.assert_array_equal(out, got.astype(np.uint8))
