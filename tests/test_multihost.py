@@ -158,8 +158,67 @@ def test_two_process_full_fit_agrees(tmp_path):
                 rank = int(line.split()[0][4:])
                 metrics[rank].append(line.split(None, 1)[1])
     assert metrics[0] and metrics[0] == metrics[1]  # bitwise-agreeing logs
-    # full-val mode: synthetic:64 -> val set 6 samples, counted ONCE
-    assert "vcount=6.0" in metrics[0][0]
+    # full-val mode: synthetic:128 -> val set 12 samples, counted ONCE
+    assert "vcount=12.0" in metrics[0][0]
     # chief-only checkpoint in each rank's private cwd
     assert (tmp_path / "rank0" / "checkpoint.pth.tar").exists()
     assert not (tmp_path / "rank1" / "checkpoint.pth.tar").exists()
+
+
+def test_four_process_fit_host_major_mesh(tmp_path):
+    """4 processes x 2 fake chips — the v5p-32-shaped (multi-host,
+    multi-chip-per-host) topology, THROUGH fit(): the hierarchical-mesh
+    host-major claim (README / mesh.py docstrings) asserted on the mesh
+    fit() actually built in every rank, all four ranks bitwise-agreeing
+    on every epoch metric, and the chief-only checkpoint guard holding
+    at world size 4."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_fit_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    world = 4
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(rank), str(tmp_path),
+             str(world)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo_root,
+        )
+        for rank in range(world)
+    ]
+    try:
+        # 4 processes compile concurrently on an oversubscribed host
+        outs = [p.communicate(timeout=_TIMEOUT * 2)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    metrics = {r: [] for r in range(world)}
+    mesh_lines = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RANK") and "EPOCH" in line:
+                rank = int(line.split()[0][4:])
+                metrics[rank].append(line.split(None, 1)[1])
+            if line.startswith("RANK") and "MESH" in line:
+                rank = int(line.split()[0][4:])
+                mesh_lines[rank] = line
+    # every rank built the SAME host-major mesh: each host's 2 chips in
+    # one contiguous block, hosts in process order — the (DCN, ICI)
+    # factored layout the docs claim
+    assert set(mesh_lines) == set(range(world)), mesh_lines
+    for rank, line in mesh_lines.items():
+        assert "host_major=True" in line, line
+        assert "procs=[0, 0, 1, 1, 2, 2, 3, 3]" in line, line
+    # DDP invariant at world 4: all ranks bitwise-agree every epoch
+    assert metrics[0]
+    for r in range(1, world):
+        assert metrics[r] == metrics[0], f"rank {r} diverged"
+    # chief-only checkpoint
+    assert (tmp_path / "rank0" / "checkpoint.pth.tar").exists()
+    for r in range(1, world):
+        assert not (tmp_path / f"rank{r}" / "checkpoint.pth.tar").exists()
