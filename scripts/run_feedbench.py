@@ -1,0 +1,177 @@
+#!/usr/bin/env python3
+"""FEEDBENCH: train on REAL JPEGs on the real chip — the joint artifact.
+
+HOSTBENCH proves the host pipeline in isolation (decode img/s/core) and
+BENCH proves the device step in isolation (synthetic uint8 batches).
+This run closes the joint: the FULL loop — on-disk JPEG → native fused
+decode-crop-resize → chunked in-place collate → async device_put →
+compiled bf16 train step — through ``fit()`` exactly as the CLIs drive
+it, for a few hundred steps, recording the throughput the chip actually
+saw and the ``starvation`` fraction (share of wall time it waited on
+host data). The reference fights this exact battle with fast_collate +
+DataPrefetcher (imagenet_ddp_apex.py:26-39,304-351,411-412).
+
+Honesty note: this box has ~1 host core while HOSTBENCH budgets ~5
+decode cores per chip (``cores_needed_per_chip``), so the expected
+result HERE is a feed-limited run whose throughput ≈ the host decode
+rate and whose starvation fraction ≈ 1 - feed/chip capability. The
+artifact's value is that the joint numbers exist and AGREE with the two
+halves — images_per_sec ≈ HOSTBENCH's e2e loader rate, and the
+starvation meter telling the same story at train time.
+
+Writes FEEDBENCH.json at the repo root.
+
+Usage: python scripts/run_feedbench.py [--images 1280] [--epochs 10]
+                                       [--batch 64]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_imagefolder(root, n_train, n_val, n_classes=8):
+    """ImageNet-shaped JPEGs (~500x400 q85, textured) in ImageFolder
+    layout — the HOSTBENCH generator, split into classes."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for split, n in (("train", n_train), ("val", n_val)):
+        per = max(1, n // n_classes)
+        for c in range(n_classes):
+            d = os.path.join(root, split, f"class{c}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(per):
+                low = rng.randint(0, 255, (50, 40, 3), np.uint8)
+                img = np.asarray(
+                    Image.fromarray(low).resize((500, 400), Image.BILINEAR)
+                )
+                img = np.clip(
+                    img.astype(np.int16)
+                    + rng.randint(-20, 20, img.shape),
+                    0, 255,
+                ).astype(np.uint8)
+                Image.fromarray(img).save(
+                    os.path.join(d, f"{i}.jpg"), quality=85
+                )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=1280)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--out", default="FEEDBENCH.json")
+    args = ap.parse_args()
+
+    from dptpu.config import Config
+    from dptpu.data import native_image
+    from dptpu.train import fit
+
+    if not native_image.available():
+        print("native decoder unavailable — FEEDBENCH needs it", file=sys.stderr)
+        return 1
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="dptpu_feedbench_")
+    t0 = time.time()
+    make_imagefolder(tmp, args.images, max(args.batch, args.images // 10))
+    gen_s = time.time() - t0
+
+    # apex-variant config: bf16 compute via --opt-level O2, the headline
+    # bench's dtype; one real chip (or whatever this host exposes)
+    cfg = Config(
+        data=tmp,
+        variant="apex",
+        arch="resnet50",
+        epochs=args.epochs,
+        batch_size=args.batch,
+        lr=0.05,
+        workers=args.workers,
+        print_freq=50,
+        seed=0,
+        opt_level="O2",
+    )
+    cwd = os.getcwd()
+    rundir = tempfile.mkdtemp(prefix="dptpu_feedbench_run_")
+    os.chdir(rundir)  # checkpoints + TB runs/ land here, not the repo
+    try:
+        t0 = time.time()
+        result = fit(cfg, verbose=True)
+        train_s = time.time() - t0
+    finally:
+        os.chdir(cwd)
+
+    hist = result["history"]
+    # drop epoch 0 (compile + loader warmup); average the steady state
+    steady = hist[1:] if len(hist) > 1 else hist
+    bt = float(np.mean([h["train_batch_time"] for h in steady]))
+    dt = float(np.mean([h["train_data_time"] for h in steady]))
+    starv = float(np.mean([h["train_starvation"] for h in steady]))
+    rate = args.batch / bt if bt else 0.0
+
+    steps_per_epoch = (args.images // args.batch)
+    hostbench = {}
+    hb_path = os.path.join(os.path.dirname(args.out) or ".", "HOSTBENCH.json")
+    if os.path.exists(hb_path):
+        with open(hb_path) as f:
+            hb = json.load(f)
+        hostbench = {
+            "loader_e2e_imgs_per_sec_per_core":
+                hb.get("loader_e2e_imgs_per_sec_per_core"),
+            "cores_needed_per_chip": hb.get("cores_needed_per_chip"),
+        }
+
+    out = {
+        "round": 5,
+        "what": "fit() on real on-disk JPEGs, native decode, real chip",
+        "arch": "resnet50",
+        "dtype": "bf16 (apex --opt-level O2)",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "host_cpu_count": os.cpu_count(),
+        "jpeg": "500x400 q85 (ImageNet-median shape)",
+        "images_train": args.images,
+        "batch_size": args.batch,
+        "epochs": len(hist),
+        "steps_total": steps_per_epoch * len(hist),
+        "images_per_sec": round(rate, 1),
+        "batch_time_s": round(bt, 4),
+        "data_time_s": round(dt, 4),
+        "starvation": round(starv, 4),
+        "train_wall_s": round(train_s, 1),
+        "jpeg_gen_s": round(gen_s, 1),
+        "final_train_top1": round(float(hist[-1]["train_top1"]), 2),
+        "hostbench_crosscheck": hostbench,
+        "per_epoch": [
+            {
+                "epoch": h["epoch"],
+                "images_per_sec": round(
+                    args.batch / max(h["train_batch_time"], 1e-9), 1
+                ),
+                "data_time_s": round(h["train_data_time"], 4),
+                "starvation": round(h["train_starvation"], 4),
+            }
+            for h in hist
+        ],
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in (
+        "images_per_sec", "starvation", "data_time_s", "batch_time_s",
+        "host_cpu_count", "steps_total")}))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
