@@ -61,6 +61,18 @@ def dp_specs(params):
     return jax.tree_util.tree_map(lambda _: P(), params)
 
 
+def _mlp_pair_spec(names):
+    """Shared Megatron column→row rule for an ``mlp_1``/``mlp_2`` Dense
+    pair (the naming every transformer family in the zoo uses); None for
+    any other leaf so family rules can layer their own branches."""
+    mod = names[-2] if len(names) > 1 else ""
+    if mod == "mlp_1":  # column-parallel
+        return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
+    if mod == "mlp_2":  # row-parallel: split the input dim
+        return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
+    return None
+
+
 def vit_tp_specs(params):
     """PartitionSpec tree for ViT: Megatron tensor parallelism over the
     ``model`` axis for BOTH halves of every encoder layer, everything
@@ -84,10 +96,13 @@ def vit_tp_specs(params):
 
     def spec(path, leaf):
         names = [p.key for p in path]
+        mlp = _mlp_pair_spec(names)
+        if mlp is not None:
+            return mlp
         mod = names[-2] if len(names) > 1 else ""
-        if mod in ("mlp_1", "in_proj"):  # column-parallel
+        if mod == "in_proj":  # column-parallel
             return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
-        if mod in ("mlp_2", "out_proj"):  # row-parallel: split the input dim
+        if mod == "out_proj":  # row-parallel: split the input dim
             return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
         return P()
 
@@ -119,10 +134,13 @@ def swin_tp_specs(params):
 
     def spec(path, leaf):
         names = [p.key for p in path]
+        mlp = _mlp_pair_spec(names)
+        if mlp is not None:
+            return mlp
         mod = names[-2] if len(names) > 1 else ""
-        if mod in ("mlp_1", "qkv", "cpb_mlp_2"):  # column-parallel
+        if mod in ("qkv", "cpb_mlp_2"):  # column-parallel
             return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
-        if mod in ("mlp_2", "proj"):  # row-parallel: split the input dim
+        if mod == "proj":  # row-parallel: split the input dim
             return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
         if names[-1] == "logit_scale":  # (heads, 1, 1)
             return P(MODEL_AXIS)
@@ -133,20 +151,48 @@ def swin_tp_specs(params):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def convnext_tp_specs(params):
+    """PartitionSpec tree for ConvNeXt: Megatron column→row TP for every
+    block's MLP pair over the ``model`` axis, everything else
+    replicated.
+
+    The CNBlock is ``dwconv → LayerNorm → mlp_1 (C→4C) → GELU → mlp_2
+    (4C→C) → layer_scale``: the FLOPs live in the two pointwise Linears,
+    which take the standard column/row split with ONE partitioner
+    all-reduce per block. The depthwise conv is per-channel and
+    negligible-FLOP, and ConvNeXt's LayerNorm normalizes over the
+    channel dim — sharding channels there would buy a collective per
+    LN — so dw/norm/layer_scale (and stem/downsample/head) stay
+    replicated. Any model-axis size dividing every stage's 4·dim is
+    aligned: stage hiddens run 384→3072 (tiny/small), 512→4096 (base),
+    768→6144 (large) — all divisible by 2/4/8."""
+
+    def spec(path, leaf):
+        names = [p.key for p in path]
+        mlp = _mlp_pair_spec(names)
+        return mlp if mlp is not None else P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
 def tp_rule_for_arch(arch: str) -> str:
     """Name the tensor-parallel sharding rule for an arch.
 
-    The two attention families with head-major fused-qkv storage get
-    real TP (``vit_*`` → ``vit_tp_specs``; ``swin*`` v1/v2 →
-    ``swin_tp_specs``); every other arch — CNNs and MaxViT
-    (conv-hybrid, see ``swin_tp_specs`` scope note) — answers
-    ``dp_specs``. Arch-name-only so ``fit()`` can decide BEFORE mesh
-    construction: a dp fallback should get the flat full-width data
-    mesh, not a factored one with a redundant model axis."""
+    Three families get real TP: the two attention families with
+    head-major fused-qkv storage (``vit_*`` → ``vit_tp_specs``;
+    ``swin*`` v1/v2 → ``swin_tp_specs``) and ConvNeXt's MLP pair
+    (``convnext_*`` → ``convnext_tp_specs``). Every other arch —
+    classic CNNs and MaxViT (conv-hybrid, see ``swin_tp_specs`` scope
+    note) — answers ``dp_specs``. Arch-name-only so ``fit()`` can
+    decide BEFORE mesh construction: a dp fallback should get the flat
+    full-width data mesh, not a factored one with a redundant model
+    axis."""
     if arch.startswith("vit_"):
         return "vit_tp_specs"
     if arch.startswith("swin"):
         return "swin_tp_specs"
+    if arch.startswith("convnext"):
+        return "convnext_tp_specs"
     return "dp_specs"
 
 
@@ -154,7 +200,7 @@ def tp_specs_for_arch(arch: str, params):
     """``(rule_name, specs)`` for ``tp_rule_for_arch``'s choice."""
     rule = tp_rule_for_arch(arch)
     fn = {"vit_tp_specs": vit_tp_specs, "swin_tp_specs": swin_tp_specs,
-          "dp_specs": dp_specs}[rule]
+          "convnext_tp_specs": convnext_tp_specs, "dp_specs": dp_specs}[rule]
     return rule, fn(params)
 
 

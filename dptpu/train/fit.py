@@ -143,8 +143,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         if verbose:
             print(
                 f"=> DPTPU_TP={tp_n}: no tensor-parallel rule for "
-                f"'{cfg.arch}' (TP ships for vit_*/swin*; CNNs and "
-                f"MaxViT keep the data axis — see dp_specs docstring) — "
+                f"'{cfg.arch}' (TP ships for vit_*/swin*/convnext_*; classic "
+                f"CNNs and MaxViT keep the data axis — see dp_specs "
+                f"docstring) — "
                 f"running data parallelism over all "
                 f"{jax.device_count()} devices instead"
             )
