@@ -35,6 +35,16 @@ One transpose subtlety: a Linear that consumes a *flattened conv map*
 in torch but HWC-ordered inputs here, so its kernel needs a spatial
 permutation, not just the OI->IO transpose — handled by the
 ``dense_chw`` kinds below (shapes alone would silently match).
+
+Fidelity evidence (``scripts/check_tv_parity.py``, committed as
+TV_PARITY.json): the conversion round-trips at LOGIT level exactly —
+dptpu params -> torch layout (``_to_torch``) -> back through
+``convert_state_dict`` -> forward gives ``max|Δlogit| = 0.0`` for
+resnet50, vit_b_16 and swin_t (every permute/transpose kind inverts
+bit-exactly), and the val pipeline is pixel-exact to torchvision's
+``Resize(256)→CenterCrop(224)`` (±1 LSB; dptpu/data/transforms.py).
+Run the harness where torch+torchvision exist for the published-weight
+cross-framework ``max|Δlogit|`` / top-1-agreement numbers per arch.
 """
 
 from __future__ import annotations
