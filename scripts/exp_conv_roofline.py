@@ -14,7 +14,7 @@ bf16 peak 197 TFLOP/s, sustained HBM 635 GB/s. Methodology cautions
 from PERF.md apply: wall clock lies on this relay; only the trace's
 per-op durations are trustworthy.
 
-Writes /tmp/conv_roofline.json and prints the table.
+Writes CONV_ROOFLINE.json (repo root) and prints the table.
 
 Usage: python scripts/exp_conv_roofline.py [--batch 128] [--iters 6]
 """
