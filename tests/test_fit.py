@@ -261,3 +261,35 @@ def test_fit_gspmd_flag_trains_and_yields_to_zero1(tiny_imagenet, tmp_path,
     out = capsys.readouterr().out
     assert "DPTPU_GSPMD ignored: DPTPU_ZERO1 takes precedence" in out
     assert "ZeRO-1 optimizer-state sharding" in out
+
+
+def test_tp_sp_env_knob_error_contracts(tiny_imagenet, monkeypatch):
+    """The DPTPU_TP/DPTPU_SP knobs fail FAST and actionably — before any
+    model build or compile — on junk values, negatives, bad modes, and
+    non-divisor axis sizes."""
+    cfg = Config(data=tiny_imagenet, arch="vit_b_32", epochs=1,
+                 batch_size=24, workers=1)
+    monkeypatch.setenv("DPTPU_TP", "two")
+    with pytest.raises(ValueError, match="not an integer"):
+        fit(cfg, image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_TP", "-4")
+    with pytest.raises(ValueError, match="positive"):
+        fit(cfg, image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_TP", "3")  # 3 does not divide 8 devices
+    with pytest.raises(ValueError, match="does not divide"):
+        fit(cfg, image_size=32, verbose=False)
+    monkeypatch.delenv("DPTPU_TP")
+    monkeypatch.setenv("DPTPU_SP", "two")
+    with pytest.raises(ValueError, match="not an integer"):
+        fit(cfg, image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_SP", "-4")
+    with pytest.raises(ValueError, match="positive"):
+        fit(cfg, image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_SP", "2")
+    monkeypatch.setenv("DPTPU_SP_MODE", "ringg")
+    with pytest.raises(ValueError, match="ulysses.*ring|'ulysses' or 'ring'"):
+        fit(cfg, image_size=32, verbose=False)
+    monkeypatch.setenv("DPTPU_SP_MODE", "ring")
+    monkeypatch.setenv("DPTPU_SP", "5")  # 5 does not divide 8
+    with pytest.raises(ValueError, match="does not divide"):
+        fit(cfg, image_size=32, verbose=False)
