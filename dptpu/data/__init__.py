@@ -8,6 +8,7 @@ and a double-buffered device prefetcher that overlaps host decode + H2D with
 the running step.
 """
 
+from dptpu.data.cache import DecodeCache
 from dptpu.data.dataset import ImageFolderDataset, SyntheticDataset
 from dptpu.data.loader import DataLoader, DevicePrefetcher
 from dptpu.data.sampler import ShardedSampler
@@ -22,6 +23,7 @@ from dptpu.data.transforms import (
 
 __all__ = [
     "DataLoader",
+    "DecodeCache",
     "DevicePrefetcher",
     "ImageFolderDataset",
     "ShardedSampler",

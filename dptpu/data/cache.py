@@ -1,0 +1,138 @@
+"""RAM-budgeted decoded-sample cache for the input pipeline.
+
+JPEG Huffman decode + IDCT is the dominant share of per-item host cost
+(HOSTBENCH: the native fused path spends most of its time inside libjpeg,
+not the crop-resize). Across epochs the pipeline decodes the SAME files
+again and again, varying only the sampled crop/flip — so ``DecodeCache``
+keeps the decoded full-resolution RGB pixels and epoch 1+ re-applies only
+the per-epoch augmentation (crop/resize/flip), skipping the decode
+entirely on a hit. The same idea drives every fast-ImageNet input
+pipeline (DALI's decoder cache, tf.data's ``.cache()``); here it is
+byte-budgeted and in-process.
+
+Semantics:
+
+* **Byte budget, LRU eviction.** ``put`` accounts ``arr.nbytes``; least-
+  recently-used entries are evicted until the new entry fits. Entries
+  larger than the whole budget are rejected (never cached), so one huge
+  image cannot flush the working set.
+* **Bit-stable hit path.** The dataset's cache-aware decode fills the
+  cache with the SAME decoded pixels the miss path then resamples from
+  (``native_image.decode_into_cache`` / PIL full decode), so a hit and a
+  miss produce identical output for identical augmentation RNG — cache
+  warmth never changes what a seeded run trains on.
+* **Process-pool friendly.** Pickling transfers the budget but NOT the
+  contents (workers warm their own), and ``scale_budget`` divides the
+  budget across a worker pool so ``cache_bytes`` stays the TOTAL RAM
+  spend no matter the worker count.
+
+Thread-safe; stats (hits/misses/evictions/bytes) feed the loader's
+``feed_stats`` telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class DecodeCache:
+    """LRU byte-budgeted map of hashable keys → decoded uint8 arrays."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError(
+                f"cache budget must be positive, got {budget_bytes} "
+                f"(omit the cache instead of zero-sizing it)"
+            )
+        self.budget_bytes = int(budget_bytes)
+        self._entries: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- core ---------------------------------------------------------------
+
+    def get(self, key):
+        """The cached array for ``key`` (marked most-recently-used), or
+        None. Callers must treat the result as READ-ONLY: it is the
+        shared decoded buffer every future hit resamples from."""
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return arr
+
+    def put(self, key, arr: np.ndarray) -> bool:
+        """Insert ``arr`` under ``key``, evicting LRU entries to fit the
+        byte budget. Returns False (not cached) when ``arr`` alone
+        exceeds the budget."""
+        nbytes = int(arr.nbytes)
+        # the stored buffer is shared by every future hit: freeze it so
+        # an aliasing caller fails loudly instead of corrupting the cache
+        arr.flags.writeable = False
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._bytes + nbytes > self.budget_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+            self._entries[key] = arr
+            self._bytes += nbytes
+            return True
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "cache_hits": self.hits,
+                "cache_misses": self.misses,
+                "cache_evictions": self.evictions,
+                "cache_entries": len(self._entries),
+                "cache_bytes_in_use": self._bytes,
+                "cache_budget_bytes": self.budget_bytes,
+                "cache_hit_rate": (self.hits / total) if total else 0.0,
+            }
+
+    # -- pooling ------------------------------------------------------------
+
+    def scale_budget(self, divisor: int):
+        """Divide the budget by ``divisor`` (process-pool split: each of N
+        workers keeps 1/N of the configured TOTAL budget). Existing
+        entries are evicted down to the new budget."""
+        if divisor <= 0:
+            raise ValueError(f"divisor must be positive, got {divisor}")
+        with self._lock:
+            self.budget_bytes = max(1, self.budget_bytes // divisor)
+            while self._bytes > self.budget_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+
+    def __getstate__(self):
+        # budget crosses the pickle boundary; contents do not (each
+        # process-pool worker warms its own working set)
+        return {"budget_bytes": self.budget_bytes}
+
+    def __setstate__(self, state):
+        self.__init__(state["budget_bytes"])

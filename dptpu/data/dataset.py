@@ -23,12 +23,49 @@ _IMG_EXTENSIONS = (
 )
 
 
-class ImageFolderDataset:
-    """root/<class_name>/<image> layout, torchvision class-index semantics."""
+def _copy_checked(out: np.ndarray, img, index: int):
+    """Copy a decoded sample into a preallocated batch row, surfacing the
+    loader's fixed-shape contract instead of numpy's broadcast error."""
+    img = np.asarray(img)
+    if img.shape != out.shape:
+        raise ValueError(
+            f"sample {index} decoded to shape {img.shape}, but the batch "
+            f"was preallocated for shape {out.shape} (probed from the "
+            f"first sample). DataLoader requires every sample to share "
+            f"one shape — use a sizing transform (train_transform/"
+            f"val_transform) or pre-resize the dataset."
+        )
+    np.copyto(out, img)
 
-    def __init__(self, root: str, transform: Optional[Callable] = None):
+
+class ImageFolderDataset:
+    """root/<class_name>/<image> layout, torchvision class-index semantics.
+
+    ``cache_bytes > 0`` attaches a :class:`dptpu.data.cache.DecodeCache`:
+    decoded full-resolution pixels are kept (LRU, byte-budgeted) and
+    epoch 1+ re-applies only the per-epoch crop/resize/flip — a cache hit
+    skips JPEG Huffman decode entirely. Hits and misses produce identical
+    pixels for identical augmentation RNG (both resample the same decoded
+    buffer), so cache warmth never changes what a seeded run sees. Note
+    the cached native path decodes at FULL resolution on a miss (the
+    buffer must serve every future crop), whereas the uncached path may
+    use libjpeg's crop-dependent scaled decode — pixels between
+    cache-on and cache-off therefore match bit-for-bit only when the
+    scale picker stays at 8/8 (always true when no crop axis reaches
+    ``out_size*8/7``); for larger images the cached path resamples from
+    strictly higher-resolution source pixels.
+    """
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 cache_bytes: int = 0):
         self.root = root
         self.transform = transform
+        if cache_bytes:
+            from dptpu.data.cache import DecodeCache
+
+            self.decode_cache = DecodeCache(cache_bytes)
+        else:
+            self.decode_cache = None
         classes = sorted(
             d for d in os.listdir(root)
             if os.path.isdir(os.path.join(root, d))
@@ -66,6 +103,23 @@ class ImageFolderDataset:
 
         if not native_image.available():
             return None
+        if self.decode_cache is not None:
+            full = self.decode_cache.get(("native", path))
+            if full is None:
+                with open(path, "rb") as f:
+                    data = f.read()
+                dims = native_image.jpeg_dims(data)
+                if dims is None:
+                    return None
+                full = np.empty((dims[1], dims[0], 3), np.uint8)
+                if not native_image.decode_into_cache(data, full):
+                    return None
+                self.decode_cache.put(("native", path), full)
+            h, w = full.shape[:2]
+            box, flip = self.transform.sample(w, h, rng)
+            return native_image.crop_resize(
+                full, box, self.transform.size, flip, out=out
+            )
         with open(path, "rb") as f:
             data = f.read()
         dims = native_image.jpeg_dims(data)
@@ -79,6 +133,19 @@ class ImageFolderDataset:
     def _pil_decode(self, path: str, rng):
         from PIL import Image
 
+        if self.decode_cache is not None:
+            arr = self.decode_cache.get(("pil", path))
+            if arr is None:
+                with Image.open(path) as img:
+                    arr = np.asarray(img.convert("RGB"))
+                self.decode_cache.put(("pil", path), arr)
+            if self.transform is None:
+                # callers own (and may mutate) what get() returns — hand
+                # out a copy, never the shared cached buffer
+                return arr.copy()
+            # re-applying the transform to the cached full decode is
+            # bit-identical to the uncached PIL path (same source pixels)
+            return self.transform(Image.fromarray(arr), rng)
         with Image.open(path) as img:
             img = img.convert("RGB")
             if self.transform is None:
@@ -111,7 +178,7 @@ class ImageFolderDataset:
         path, label = self.samples[index]
         nat = self._native_decode(path, rng, out=out)
         if nat is None:
-            np.copyto(out, self._pil_decode(path, rng))
+            _copy_checked(out, self._pil_decode(path, rng), index)
         elif nat is not out:  # non-contiguous out fell back to a fresh array
             np.copyto(out, nat)
         return label
@@ -152,7 +219,7 @@ class SyntheticDataset:
         """Loader fast-path API parity with ImageFolderDataset (one copy
         into the preallocated batch row; generation dominates anyway)."""
         img, label = self.get(index, rng)
-        np.copyto(out, img)
+        _copy_checked(out, img, index)
         return label
 
     def __getitem__(self, index: int):

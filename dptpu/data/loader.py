@@ -1,22 +1,33 @@
-"""Threaded batch loader + double-buffered device prefetcher.
+"""Batch loader (thread- or process-backed) + double-buffered prefetcher.
 
 The torch ``DataLoader(num_workers=j)`` + Apex ``fast_collate`` +
 ``DataPrefetcher`` trio (reference imagenet_ddp.py:178-194;
 imagenet_ddp_apex.py:26-39,304-351), rebuilt for the TPU host model:
 
-* decode/transform on a thread pool (PIL/libjpeg release the GIL for the
-  heavy work — no process fork needed, unlike torch workers);
-* CHUNKED submission, decoded in place: each batch submits one future per
-  worker (not per image), and each worker decodes its span of samples
-  DIRECTLY into the preallocated uint8 NHWC batch (``dataset.get_into`` →
-  the native decoder's caller-supplied output buffer) — fast_collate's
-  "no float conversion on CPU" insight (×4 less H2D traffic) without the
-  per-image future dispatch + intermediate-array memcpy that round 4's
-  HOSTBENCH measured as ~19% of a decode core;
+* decode/transform on a worker pool. ``workers_mode="thread"`` uses a
+  thread pool (PIL/libjpeg release the GIL for the pixel work);
+  ``workers_mode="process"`` uses spawned worker processes writing into
+  a shared-memory batch ring (``dptpu/data/shm.py``) — the GIL caps the
+  thread pool at ~1 core of useful decode on real hosts (HOSTBENCH r5:
+  542.8 img/s at 8 threads vs 516.6 at 1), while processes scale with
+  host cores and pixels still never get pickled;
+* CHUNKED submission, decoded in place: each batch submits one span per
+  worker (not one task per image), and each worker decodes its span of
+  samples DIRECTLY into the preallocated uint8 NHWC batch
+  (``dataset.get_into`` → the native decoder's caller-supplied output
+  buffer) — fast_collate's "no float conversion on CPU" insight (×4 less
+  H2D traffic) without the per-image dispatch + intermediate-array
+  memcpy that round 4's HOSTBENCH measured as ~19% of a decode core;
 * keep ``prefetch_batches`` batches in flight so decode overlaps step time;
 * per-item augmentation RNG derived from ``(seed, epoch, sample_index)`` —
-  reproducible regardless of thread scheduling (the ``--seed`` contract,
-  nd_imagenet.py:68-69, without torch's worker_init_fn caveats);
+  reproducible regardless of worker scheduling OR workers_mode: thread
+  and process loaders yield bit-identical batches for the same seed (the
+  ``--seed`` contract, nd_imagenet.py:68-69, without torch's
+  worker_init_fn caveats; locked in tests/test_shm_loader.py);
+* FIXED-SHAPE contract: the first sample's transformed shape is probed
+  once and every batch is preallocated to it — all samples must share
+  one shape (use a sizing transform). A mismatched sample raises a
+  ``ValueError`` naming the offending index, not a broadcast error.
 * ``DevicePrefetcher`` stays one batch ahead on-device: ``device_put`` /
   ``make_array_from_process_local_data`` dispatch is async in JAX, so the
   H2D copy of batch N+1 rides under the compute of batch N — the CUDA
@@ -51,7 +62,13 @@ class DataLoader:
     def __init__(self, dataset, batch_size: int,
                  sampler: Optional[ShardedSampler] = None,
                  num_workers: int = 4, drop_last: bool = False,
-                 pad_final: bool = True, seed: int = 0):
+                 pad_final: bool = True, seed: int = 0,
+                 workers_mode: str = "thread", mp_start: str = "spawn"):
+        if workers_mode not in ("thread", "process"):
+            raise ValueError(
+                f"workers_mode={workers_mode!r} must be 'thread' or "
+                f"'process'"
+            )
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler or ShardedSampler(len(dataset), shuffle=False)
@@ -59,11 +76,20 @@ class DataLoader:
         self.drop_last = drop_last
         self.pad_final = pad_final
         self.seed = seed
+        self.workers_mode = workers_mode
+        self.mp_start = mp_start
         self._get = getattr(dataset, "get", None)
         self._get_into = getattr(dataset, "get_into", None)
         self._item_shape = None  # probed from the first sample
-        self._pool = ThreadPoolExecutor(
-            max_workers=self.num_workers, thread_name_prefix="dptpu-data"
+        self._probe = None  # (index, epoch, img, label) — reused for row 0
+        self._pipeline = None  # lazy shm ring (process mode)
+        self._prev_cache_counts = (0, 0)  # feed_stats interval baseline
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=self.num_workers, thread_name_prefix="dptpu-data"
+            )
+            if workers_mode == "thread"
+            else None
         )
 
     def __len__(self) -> int:
@@ -81,15 +107,25 @@ class DataLoader:
         ``offset..offset+len(idxs)`` of the shared batch arrays — the
         per-worker unit of a chunked submission (disjoint rows, so
         concurrent spans never race)."""
+        from dptpu.data.dataset import _copy_checked
+
         get_into = self._get_into
         for j, index in enumerate(idxs):
             index = int(index)
-            if get_into is not None:
+            probe = self._probe
+            if (probe is not None and probe[0] == index
+                    and probe[1] == epoch):
+                # the shape probe already decoded this exact sample with
+                # this exact rng — reuse it instead of decoding twice
+                self._probe = None
+                imgs[offset + j] = probe[2]
+                labels[offset + j] = probe[3]
+            elif get_into is not None:
                 rng = np.random.default_rng([self.seed, epoch, index])
                 labels[offset + j] = get_into(index, rng, imgs[offset + j])
             else:
                 img, label = self._load_one(index, epoch)
-                imgs[offset + j] = img
+                _copy_checked(imgs[offset + j], img, index)
                 labels[offset + j] = label
 
     def _submit_batch(self, batch_indices, epoch):
@@ -114,6 +150,10 @@ class DataLoader:
     def _finalize(self, futs, imgs, labels, n_valid, valid=None):
         for f in futs:
             f.result()  # wait + propagate decode errors
+        return self._assemble(imgs, labels, n_valid, valid)
+
+    def _assemble(self, imgs, labels, n_valid, valid=None):
+        """Pad/mask policy shared by the thread and process backends."""
         batch = {"images": imgs, "labels": labels}
         out_size = imgs.shape[0]
         # the eval mask flags positions an exact aggregation must skip:
@@ -145,12 +185,19 @@ class DataLoader:
         chunks = [(indices[sl(b)], valid[sl(b)]) for b in range(nb)]
         if self._item_shape is None and nb:
             # one probe decode fixes the item shape for preallocation
-            # (cached on the loader; only the first epoch() call pays)
-            img, _ = self._load_one(int(chunks[0][0][0]), epoch)
-            self._item_shape = np.asarray(img).shape
+            # (cached on the loader; only the first epoch() call pays —
+            # and thread mode reuses the decode for the sample's row)
+            probe_idx = int(chunks[0][0][0])
+            img, label = self._load_one(probe_idx, epoch)
+            img = np.asarray(img)
+            self._item_shape = img.shape
+            self._probe = (probe_idx, epoch, img, label)
 
-        pending = deque()
         ahead = 1 + max(0, prefetch_batches)
+        if self.workers_mode == "process":
+            yield from self._epoch_process(chunks, epoch, ahead)
+            return
+        pending = deque()
         for chunk, _ in chunks[:ahead]:
             pending.append(self._submit_batch(chunk, epoch))
         next_idx = ahead
@@ -161,8 +208,82 @@ class DataLoader:
                 next_idx += 1
             yield self._finalize(*item, valid=chunks[b][1])
 
+    def _epoch_process(self, chunks, epoch, ahead):
+        """Process-mode epoch: drive the shared-memory slot ring
+        (dptpu/data/shm.py) with the same submit-ahead/collect-in-order
+        cadence as the thread path."""
+        if not chunks:
+            return
+        self._probe = None  # workers decode row 0 themselves
+        pipe = self._ensure_pipeline(slots=ahead + 1)
+        pipe.reset()  # reclaim slots from an abandoned prior epoch
+        nb = len(chunks)
+        pending = deque()
+        for chunk, _ in chunks[:ahead]:
+            pending.append(pipe.submit(chunk, epoch))
+        next_idx = ahead
+        for b in range(nb):
+            slot, n_valid = pending.popleft()
+            if next_idx < nb:
+                pending.append(pipe.submit(chunks[next_idx][0], epoch))
+                next_idx += 1
+            out_size = self.batch_size if self.pad_final else n_valid
+            imgs, labels = pipe.collect(slot, out_size)
+            yield self._assemble(imgs, labels, n_valid, valid=chunks[b][1])
+
+    def _ensure_pipeline(self, slots: int):
+        from dptpu.data.shm import ShmBatchPipeline
+
+        if self._pipeline is not None and self._pipeline.slots < slots:
+            # prefetch depth grew between epochs: rebuild the ring
+            self._pipeline.close()
+            self._pipeline = None
+        if self._pipeline is None:
+            self._pipeline = ShmBatchPipeline(
+                self.dataset, self.batch_size, self._item_shape,
+                num_workers=self.num_workers, seed=self.seed, slots=slots,
+                mp_start=self.mp_start,
+            )
+            # fresh workers count from zero: re-baseline the interval
+            # hit-rate bookkeeping in feed_stats
+            self._prev_cache_counts = (0, 0)
+        return self._pipeline
+
+    def feed_stats(self) -> dict:
+        """Pipeline telemetry for the train loop: worker configuration +
+        decode-cache counters (pool-aggregated in process mode).
+
+        ``cache_hits``/``cache_misses`` are cumulative since loader
+        creation; ``cache_hit_rate`` covers the INTERVAL since the
+        previous ``feed_stats()`` call (→ per-epoch when called once per
+        epoch, as the train loop does), so a warm epoch reads ~1.0
+        instead of being diluted by epoch-0 fill misses."""
+        stats = {
+            "workers_mode": self.workers_mode,
+            "num_workers": self.num_workers,
+        }
+        if self.workers_mode == "process":
+            if self._pipeline is not None:
+                stats.update(self._pipeline.cache_stats())
+        else:
+            cache = getattr(self.dataset, "decode_cache", None)
+            if cache is not None:
+                stats.update(cache.stats())
+        if "cache_hits" in stats:
+            dh = stats["cache_hits"] - self._prev_cache_counts[0]
+            dm = stats["cache_misses"] - self._prev_cache_counts[1]
+            self._prev_cache_counts = (
+                stats["cache_hits"], stats["cache_misses"]
+            )
+            stats["cache_hit_rate"] = dh / (dh + dm) if dh + dm else 0.0
+        return stats
+
     def close(self):
-        self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._pipeline is not None:
+            self._pipeline.close()
+            self._pipeline = None
 
 
 class DevicePrefetcher:

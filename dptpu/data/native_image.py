@@ -56,3 +56,50 @@ def decode_crop_resize(data: bytes, box, out_size: int, flip: bool,
         out.ctypes.data_as(ctypes.c_void_p),
     )
     return out if rc == 0 else None
+
+
+def decode_into_cache(data: bytes, out: np.ndarray) -> bool:
+    """Full-resolution RGB decode into ``out`` (H, W, 3 uint8, C-contiguous,
+    sized from ``jpeg_dims``) — the decode-cache FILL path.
+
+    Uses the same libjpeg settings as ``decode_crop_resize`` at scale 8/8
+    (JCS_RGB, IFAST DCT), so a subsequent ``crop_resize`` from this buffer
+    reproduces the fused path bit-for-bit whenever the fused path's scale
+    picker stays at full resolution. Returns False on failure (caller falls
+    back to the uncached path)."""
+    lib = load_library()
+    if lib is None:
+        return False
+    if (out.dtype != np.uint8 or out.ndim != 3 or out.shape[2] != 3
+            or not out.flags["C_CONTIGUOUS"]):
+        return False
+    h, w = out.shape[:2]
+    rc = lib.dptpu_jpeg_decode_rgb(
+        data, len(data), w, h, out.ctypes.data_as(ctypes.c_void_p)
+    )
+    return rc == 0
+
+
+def crop_resize(src: np.ndarray, box, out_size: int, flip: bool,
+                out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
+    """Crop ``box`` (left, top, w, h in ``src`` coords) + bilinear resize to
+    ``out_size``² (+flip) from a decoded RGB buffer — the decode-cache HIT
+    path, skipping JPEG decode entirely. Same fixed-point kernel as
+    ``decode_crop_resize``; ``out`` semantics match it too."""
+    lib = load_library()
+    if lib is None:
+        return None
+    if (src.dtype != np.uint8 or src.ndim != 3 or src.shape[2] != 3
+            or not src.flags["C_CONTIGUOUS"]):
+        return None
+    if (out is None or out.dtype != np.uint8
+            or out.shape != (out_size, out_size, 3)
+            or not out.flags["C_CONTIGUOUS"]):
+        out = np.empty((out_size, out_size, 3), np.uint8)
+    h, w = src.shape[:2]
+    left, top, cw, ch = (float(v) for v in box)
+    rc = lib.dptpu_crop_resize_rgb(
+        src.ctypes.data_as(ctypes.c_void_p), w, h, left, top, cw, ch,
+        out_size, int(flip), out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out if rc == 0 else None

@@ -53,21 +53,26 @@ def sample_rrc_box(width, height, rng, scale=(0.08, 1.0),
 
 
 def center_fit_box(width, height, size=224, resize=256):
-    """Resize(resize)+CenterCrop(size) as ONE (fractional) crop box that is
-    PIXEL-EXACT to torchvision's two-step pipeline.
+    """Resize(resize)+CenterCrop(size) as ONE (fractional) crop box
+    matching torchvision's two-step pipeline to within ±1 LSB of uint8
+    rounding (the enforced bound — see below).
 
     torchvision's Resize scales the short edge to ``resize`` and the long
     edge to ``int(resize * long / short)`` (truncation), then CenterCrop
     cuts ``size``² at integer offsets of THAT grid — a plain crop, no
-    second resample. A single box-resize reproduces it exactly when the
-    box is the crop rectangle mapped back through each axis's own scale:
-    output coord x spans intermediate [left, left+size), i.e. source
+    second resample. A single box-resize reproduces it when the box is
+    the crop rectangle mapped back through each axis's own scale: output
+    coord x spans intermediate [left, left+size), i.e. source
     [left·W/nw, (left+size)·W/nw) — fractional in general (the long-edge
     int() makes sx ≠ sy by a hair, and odd margins make left·s
     fractional). Round 5's A/B (scripts/check_tv_parity.py) measured the
     previous integer-box approximation at mean |Δpx| up to ~10 on
-    non-integer-scale geometries — a sub-pixel phase shift — so the box
-    is now exact; the A/B locks it at 0."""
+    non-integer-scale geometries — a sub-pixel phase shift. The exact
+    box removes that shift; what remains is the two-step pipeline's
+    intermediate uint8 quantization (it rounds the Resize(256) grid to
+    bytes before cropping, the one-box path doesn't), so the agreement
+    bound — asserted by tests/test_data.py and recorded in
+    TV_PARITY.json — is max |Δpx| ≤ 1 on < 2% of pixels, not literal 0."""
     if width <= height:
         nw, nh = resize, int(resize * height / width)
     else:

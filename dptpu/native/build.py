@@ -27,15 +27,18 @@ def _compile() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
         return True
+    # pid-unique temp: loader worker PROCESSES may race to rebuild after a
+    # source change; each compiles to its own file and the replace is atomic
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", _LIB + ".tmp", _SRC, "-ljpeg",
+        "-o", tmp, _SRC, "-ljpeg",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError):
         return False
-    os.replace(_LIB + ".tmp", _LIB)
+    os.replace(tmp, _LIB)
     return True
 
 
@@ -58,6 +61,20 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.dptpu_jpeg_decode_crop_resize.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
             # fractional crop box (exact-val-pipeline boxes are floats)
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ]
+        # decode-cache entry points: full-res decode into a caller buffer
+        # (cache fill) and crop-resize from a raw RGB buffer (cache hit)
+        lib.dptpu_jpeg_decode_rgb.restype = ctypes.c_int
+        lib.dptpu_jpeg_decode_rgb.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ]
+        lib.dptpu_crop_resize_rgb.restype = ctypes.c_int
+        lib.dptpu_crop_resize_rgb.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_double,
             ctypes.c_int, ctypes.c_int, ctypes.c_void_p,

@@ -124,6 +124,65 @@ int dptpu_jpeg_dims(const uint8_t* data, size_t size, int* width,
   return 0;
 }
 
+// Full-resolution decode into a caller buffer of expected_w x expected_h x 3
+// (dimensions from dptpu_jpeg_dims) — the decode-cache fill path. Identical
+// libjpeg settings to dptpu_jpeg_decode_crop_resize at scale 8/8 (JCS_RGB,
+// IFAST DCT), so a crop-resize from this buffer is BIT-IDENTICAL to the
+// fused path whenever the fused path's scale picker stays at full
+// resolution (it always does when no crop axis reaches out_size*8/7).
+int dptpu_jpeg_decode_rgb(const uint8_t* data, size_t size, int expected_w,
+                          int expected_h, uint8_t* out) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  cinfo.scale_num = 8;
+  cinfo.scale_denom = 8;
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.dct_method = JDCT_IFAST;
+  jpeg_start_decompress(&cinfo);
+  if (static_cast<int>(cinfo.output_width) != expected_w ||
+      static_cast<int>(cinfo.output_height) != expected_h) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -4;  // caller's buffer was sized from stale/foreign dims
+  }
+  const int dw = static_cast<int>(cinfo.output_width);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out + static_cast<size_t>(cinfo.output_scanline) * dw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Crop + bilinear resize + flip from a raw RGB buffer (src_w x src_h x 3) —
+// the decode-cache HIT path: same kernel the fused decode path uses, so
+// hit and miss produce the same pixels from the same decoded buffer.
+int dptpu_crop_resize_rgb(const uint8_t* src, int src_w, int src_h,
+                          double crop_left, double crop_top, double crop_w,
+                          double crop_h, int out_size, int flip,
+                          uint8_t* out) {
+  if (src_w <= 0 || src_h <= 0 || crop_w <= 0.0 || crop_h <= 0.0 ||
+      out_size <= 0) {
+    return -3;
+  }
+  crop_resize_bilinear(src, src_w, src_h, crop_left, crop_top, crop_w,
+                       crop_h, out_size, flip != 0, out);
+  return 0;
+}
+
 // Decode + crop box (full-resolution coords; FRACTIONAL boxes allowed —
 // the exact-val-pipeline path expresses Resize(256)+CenterCrop(224) as
 // one fractional box) + bilinear resize to out_size x out_size RGB +

@@ -59,13 +59,15 @@ def _os_environ_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() in ("1", "true", "yes")
 
 
-def _os_environ_int(name: str) -> int:
-    """Integer env knob; unset/empty → 0, junk → actionable error."""
+def _os_environ_int(name: str):
+    """Integer env knob; unset/empty → None (so callers can tell an
+    explicit 0 from absence — the fail-fast knob contract), junk →
+    actionable error."""
     import os
 
     raw = os.environ.get(name, "").strip()
     if not raw:
-        return 0
+        return None
     try:
         return int(raw)
     except ValueError:
@@ -74,7 +76,20 @@ def _os_environ_int(name: str) -> int:
         ) from None
 
 
-def _build_datasets(cfg: Config, image_size: int):
+def _axis_env_knob(name: str, what: str) -> int:
+    """Parallelism-axis env knob: unset → 0 (off); any explicit value
+    ≤ 0 raises — 0 gets the same fail-fast treatment as negatives (the
+    locked knob contract: every explicit value produces feedback, =1
+    additionally prints a no-op notice at the call site)."""
+    n = _os_environ_int(name)
+    if n is not None and n <= 0:
+        raise ValueError(
+            f"{name}={n} must be a positive {what} (e.g. {name}=2)"
+        )
+    return n or 0
+
+
+def _build_datasets(cfg: Config, image_size: int, cache_bytes: int = 0):
     import os
 
     if cfg.data.startswith("synthetic"):
@@ -84,11 +99,37 @@ def _build_datasets(cfg: Config, image_size: int):
         return train_ds, val_ds, 1000
     traindir = os.path.join(cfg.data, "train")
     valdir = os.path.join(cfg.data, "val")
-    train_ds = ImageFolderDataset(traindir, train_transform(image_size))
+    # DPTPU_CACHE_BYTES is a PER-DATASET budget: train and val each keep
+    # their own decoded-pixel cache (val redecodes the same files every
+    # epoch, so it benefits at least as much per byte)
+    train_ds = ImageFolderDataset(
+        traindir, train_transform(image_size), cache_bytes=cache_bytes
+    )
     val_ds = ImageFolderDataset(
-        valdir, val_transform(image_size, resize=int(image_size * 256 / 224))
+        valdir, val_transform(image_size, resize=int(image_size * 256 / 224)),
+        cache_bytes=cache_bytes,
     )
     return train_ds, val_ds, len(train_ds.classes)
+
+
+def _feed_knobs() -> tuple:
+    """The input-pipeline env knobs, under the locked fail-fast contract:
+    every explicit-but-invalid value raises with the accepted values."""
+    import os
+
+    workers_mode = os.environ.get("DPTPU_WORKERS_MODE", "").strip() or "thread"
+    if workers_mode not in ("thread", "process"):
+        raise ValueError(
+            f"DPTPU_WORKERS_MODE={workers_mode!r} must be 'thread' or "
+            f"'process'"
+        )
+    cache_bytes = _os_environ_int("DPTPU_CACHE_BYTES")
+    if cache_bytes is not None and cache_bytes < 0:
+        raise ValueError(
+            f"DPTPU_CACHE_BYTES={cache_bytes} must be >= 0 bytes "
+            f"(0/unset disables the decode cache)"
+        )
+    return workers_mode, cache_bytes or 0
 
 
 def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
@@ -110,12 +151,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # hierarchical mesh keeps its collectives on ICI (make_mesh guards
     # the DCN crossing). This is the trainer-level entry for the
     # vit/swin TP sharding rules in dptpu/parallel/gspmd.py.
-    tp_n = _os_environ_int("DPTPU_TP")
-    if tp_n < 0:
-        raise ValueError(
-            f"DPTPU_TP={tp_n} must be a positive model-axis size "
-            f"(e.g. DPTPU_TP=2)"
-        )
+    tp_n = _axis_env_knob("DPTPU_TP", "model-axis size")
     if tp_n == 1 and verbose:
         print("=> DPTPU_TP=1 is a no-op: a one-way model axis is just "
               "data parallelism")
@@ -163,12 +199,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # the data axis (README); CNNs have no token axis at all.
     import os as _os_sp
 
-    sp_n = _os_environ_int("DPTPU_SP")
-    if sp_n < 0:
-        raise ValueError(
-            f"DPTPU_SP={sp_n} must be a positive seq-axis size "
-            f"(e.g. DPTPU_SP=2)"
-        )
+    sp_n = _axis_env_knob("DPTPU_SP", "seq-axis size")
     sp_mode = _os_sp.environ.get("DPTPU_SP_MODE", "ulysses")
     if sp_n > 1 and sp_mode not in ("ulysses", "ring"):
         raise ValueError(
@@ -252,7 +283,21 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             "Currently, inception_v3 is not supported by this example."
         )
 
-    train_ds, val_ds, num_classes = _build_datasets(cfg, image_size)
+    # DPTPU_WORKERS_MODE=process routes decode through the shared-memory
+    # worker-process ring (dptpu/data/shm.py) — same batches bit-for-bit,
+    # but decode scales with host cores instead of the GIL; DPTPU_CACHE_BYTES
+    # budgets a decoded-pixel cache so epoch 1+ skips JPEG Huffman decode.
+    workers_mode, cache_bytes = _feed_knobs()
+    if verbose and (workers_mode != "thread" or cache_bytes):
+        print(
+            f"=> input pipeline: workers_mode={workers_mode}, "
+            f"decode cache "
+            + (f"{cache_bytes / 1e6:.0f} MB per dataset"
+               if cache_bytes else "off")
+        )
+    train_ds, val_ds, num_classes = _build_datasets(
+        cfg, image_size, cache_bytes=cache_bytes
+    )
 
     # per-host loaders over disjoint shards (DistributedSampler contract);
     # batches are per-HOST (global batch = per_host × hosts)
@@ -274,6 +319,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         drop_last=True,
         pad_final=False,
         seed=cfg.seed if cfg.seed is not None else 0,
+        workers_mode=workers_mode,
     )
     # Validation sharding follows the reference's split behavior:
     # * ddp/nd validate the FULL val set on every rank with no cross-rank
@@ -299,6 +345,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             )
         ),
         num_workers=derived.workers_per_device * derived.local_device_count,
+        workers_mode=workers_mode,
     )
     val_count_divisor = derived.num_processes if full_val else 1
     steps_per_epoch = max(len(train_loader), 1)
@@ -579,6 +626,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             num_batches=steps_per_epoch,
             print_freq=cfg.print_freq,
             verbose=verbose,
+            feed_stats=train_loader.feed_stats,
         )
         if profile_dir and derived.is_chief and epoch == start_epoch:
             jax.profiler.stop_trace()
@@ -625,6 +673,11 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             writer.add_scalar(
                 "Starvation/train", train_stats["starvation"], epoch + 1
             )
+            if "cache_hit_rate" in train_stats:
+                writer.add_scalar(
+                    "Cache/hit_rate", train_stats["cache_hit_rate"],
+                    epoch + 1,
+                )
             writer.add_scalar("Loss/train", train_stats["loss"], epoch + 1)
             writer.add_scalar("Loss/val", val_stats["loss"], epoch + 1)
             writer.add_scalar("Top1/train", train_stats["top1"], epoch + 1)

@@ -33,10 +33,15 @@ def train_one_epoch(
     num_batches: int,
     print_freq: int = 10,
     verbose: bool = True,
+    feed_stats: Callable = None,
 ):
     """One training epoch. ``batches`` yields device-ready batch dicts.
 
     Returns ``(state, stats)`` with host-float averages for the epoch.
+    ``feed_stats`` (optional, e.g. ``DataLoader.feed_stats``) is called
+    once at epoch end and its entries (workers_mode, cache hit rate, …)
+    are merged into the stats — the input-pipeline half of the feed-rate
+    telemetry, alongside the loop's own ``data_time``/``starvation``.
     """
     batch_time = AverageMeter("Time", ":6.3f")
     data_time = AverageMeter("Data", ":6.3f")
@@ -100,6 +105,9 @@ def train_one_epoch(
         "starvation": data_time.sum / max(batch_time.sum, 1e-9),
         "num_batches": i + 1,
     }
+    if feed_stats is not None:
+        for k, v in feed_stats().items():
+            stats.setdefault(k, v)
     return state, stats
 
 

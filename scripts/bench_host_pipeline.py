@@ -11,9 +11,12 @@ median), across:
 * thread count: 1 / 4 / 8 / 16 (the DataLoader pool);
 * the full train transform (RandomResizedCrop 224 + flip).
 
-Plus an end-to-end DataLoader rate (decode + collate into pinned uint8
-batches) at the default worker count. Writes HOSTBENCH.json at the repo
-root and prints one line per config.
+Plus (round 6) the end-to-end DataLoader swept over
+``workers_mode`` (thread vs shared-memory worker processes,
+dptpu/data/shm.py) × worker count, and a decode-cache A/B
+(``cache_bytes``, dptpu/data/cache.py): a cold pass vs a warm pass
+whose hits skip JPEG Huffman decode entirely. Writes HOSTBENCH.json at
+the repo root and prints one line per config.
 
 Feed-rate accounting (round 4): every rate is also reported PER CORE
 (rate / effective cores, where effective = min(threads, host cores)) and
@@ -95,22 +98,95 @@ def bench_backend(root, use_native, n_threads, seconds):
     return done / dt
 
 
-def bench_loader(root, n_workers, seconds):
-    from dptpu.data import DataLoader, ImageFolderDataset, train_transform
+def _ceiling_worker(root, seconds, out_q):
+    """One pure decode process: the loader path's per-item work with NO
+    loader machinery at all (no ring, no queues, no parent)."""
+    from dptpu.data import ImageFolderDataset, train_transform
 
     ds = ImageFolderDataset(root, train_transform(224))
-    loader = DataLoader(ds, 64, num_workers=n_workers, drop_last=True)
-    done, t0 = 0, time.perf_counter()
-    epoch = 0
+    out = np.empty((224, 224, 3), np.uint8)
+    for i in range(8):  # warmup: native lib load + file cache
+        ds.get_into(i % len(ds), np.random.default_rng([0, 0, i]), out)
+    t0 = time.perf_counter()
+    done = 0
     while time.perf_counter() - t0 < seconds:
-        for b in loader.epoch(epoch):
-            done += b["images"].shape[0]
-            if time.perf_counter() - t0 > seconds:
-                break
-        epoch += 1
-    rate = done / (time.perf_counter() - t0)
-    loader.close()
-    return rate
+        ds.get_into(done % len(ds), np.random.default_rng([0, 0, done]),
+                    out)
+        done += 1
+    out_q.put(done / (time.perf_counter() - t0))
+
+
+def bench_process_ceiling(root, n_procs, seconds):
+    """Aggregate img/s of ``n_procs`` INDEPENDENT decode processes — the
+    attainable multi-process rate of this host, free of any pipeline
+    overhead. The honest denominator for loader scaling: on shared/
+    throttled cloud hosts the N-process ceiling is itself sublinear in
+    N (cgroup quota, SMT siblings, noisy neighbors), so judging the
+    loader against ``N × single-process`` conflates host limits with
+    loader overhead."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_ceiling_worker, args=(root, seconds, q))
+        for _ in range(n_procs)
+    ]
+    for p in procs:
+        p.start()
+    total = sum(q.get() for _ in procs)
+    for p in procs:
+        p.join()
+    return total
+
+
+class LoaderBench:
+    """One end-to-end DataLoader configuration, measurable in rounds.
+
+    The loader (and its worker pool / decode cache) is created ONCE and
+    kept warm; ``measure`` times a window whenever called. This is what
+    makes the interleaved-rounds discipline possible (PERF.md rounds
+    2-4: this class of host drifts far more than the effects under
+    measurement, so configs must be sampled alternately and compared at
+    their best windows, never timed once in sequence)."""
+
+    def __init__(self, root, n_workers, workers_mode="thread",
+                 cache_bytes=0, warm_epochs=1):
+        from dptpu.data import (
+            DataLoader,
+            ImageFolderDataset,
+            train_transform,
+        )
+
+        self.ds = ImageFolderDataset(root, train_transform(224),
+                                     cache_bytes=cache_bytes)
+        self.loader = DataLoader(self.ds, 64, num_workers=n_workers,
+                                 drop_last=True,
+                                 workers_mode=workers_mode)
+        self.epoch = 0
+        # untimed warm passes: absorb worker-process spawn + native-lib
+        # load for every mode equally, and fill the decode cache so
+        # timed windows measure the steady warm state
+        for _ in range(warm_epochs):
+            for _b in self.loader.epoch(self.epoch):
+                pass
+            self.epoch += 1
+
+    def measure(self, seconds):
+        done, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            for b in self.loader.epoch(self.epoch):
+                done += b["images"].shape[0]
+                if time.perf_counter() - t0 > seconds:
+                    break
+            self.epoch += 1
+        return done / (time.perf_counter() - t0)
+
+    def stats(self):
+        return self.loader.feed_stats()
+
+    def close(self):
+        self.loader.close()
 
 
 def main():
@@ -122,6 +198,17 @@ def main():
         "--chip-rate", type=float, default=2730.0,
         help="per-chip training step rate to budget against "
              "(img/s/chip; default = the measured resnet50 bench)",
+    )
+    ap.add_argument(
+        "--cache-mb", type=int, default=512,
+        help="decode-cache budget for the cache A/B (MB; sized so the "
+             "--images working set fits: 256 imgs ≈ 154 MB decoded)",
+    )
+    ap.add_argument(
+        "--rounds", type=int, default=3,
+        help="interleaved measurement rounds for the loader sweep / "
+             "cache A/B (best window kept per config — the PERF.md "
+             "noise discipline for drifting hosts)",
     )
     args = ap.parse_args()
 
@@ -135,7 +222,7 @@ def main():
     have_native = native_image.available()
 
     cores = os.cpu_count() or 1
-    results = {"round": 5, "native_available": have_native,
+    results = {"round": 6, "native_available": have_native,
                "jpeg": "500x400 q85",
                "transform": "RandomResizedCrop(224)+flip",
                "host_cpu_count": cores,
@@ -158,7 +245,90 @@ def main():
             print(f"{name:7s} threads={threads:<3d} {rate:8.1f} img/s "
                   f"({per_core:.1f}/core)")
 
-    e2e = bench_loader(os.path.join(tmp, "train"), 8, args.seconds)
+    train_root = os.path.join(tmp, "train")
+    # e2e loader sweep: workers_mode × worker count (the GIL story) plus
+    # the decode-cache A/B, all sampled in INTERLEAVED rounds with the
+    # best window kept per config — the round-2/4 noise discipline:
+    # this host's deliverable CPU drifts by ~2x across minutes, so
+    # sequential one-shot timings are incomparable.
+    cache_budget = args.cache_mb << 20
+    cache_workers = max(1, cores)
+    # worker counts always include the host's core count: the cache A/B
+    # and the ceiling comparison key on it (a 6/12/32-core host is not
+    # in the {1,2,4,8} ladder)
+    worker_counts = sorted({1, 2, 4, 8} | {cache_workers})
+    combos = [("thread", w, 0) for w in worker_counts]
+    combos += [("process", w, 0) for w in worker_counts]
+    combos += [
+        ("thread", cache_workers, cache_budget),
+        ("process", cache_workers, cache_budget),
+    ]
+    benches, best = {}, {}
+    for key in combos:
+        mode, workers, cache_bytes = key
+        benches[key] = LoaderBench(
+            train_root, workers, workers_mode=mode,
+            cache_bytes=cache_bytes,
+            warm_epochs=2 if cache_bytes else 1,
+        )
+        best[key] = 0.0
+    ceiling = 0.0
+    for _ in range(args.rounds):
+        for key in combos:
+            best[key] = max(best[key], benches[key].measure(args.seconds))
+        # the host's own N-independent-process decode rate, sampled in
+        # the same rounds: the honest scaling denominator (sublinear on
+        # throttled/shared hosts — measured, not assumed)
+        ceiling = max(
+            ceiling,
+            bench_process_ceiling(train_root, cores, args.seconds),
+        )
+    cache_stats = {k: benches[k].stats() for k in combos if k[2]}
+    for b in benches.values():
+        b.close()
+
+    sweep = []
+    rate_1w = {}
+    for mode, workers, cache_bytes in combos:
+        if cache_bytes:
+            continue
+        rate = best[(mode, workers, 0)]
+        per_core = rate / min(workers, cores)
+        entry = {"workers_mode": mode, "workers": workers,
+                 "images_per_sec": round(rate, 1),
+                 "images_per_sec_per_core": round(per_core, 1)}
+        if workers == 1:
+            rate_1w[mode] = rate
+        if rate_1w.get(mode):
+            entry["per_core_efficiency_vs_1worker"] = round(
+                per_core / rate_1w[mode], 3
+            )
+        sweep.append(entry)
+        print(f"loader {mode:7s} workers={workers:<3d} {rate:8.1f} "
+              f"img/s ({per_core:.1f}/core, "
+              f"{entry.get('per_core_efficiency_vs_1worker', 1.0):.2f}x "
+              f"1-worker/core)")
+    results["loader_sweep"] = sweep
+    results["loader_sweep_rounds"] = args.rounds
+    at_cores = [e for e in sweep
+                if e["workers_mode"] == "process" and e["workers"] == cores]
+    if at_cores:
+        results["process_per_core_efficiency_at_cores"] = (
+            at_cores[0].get("per_core_efficiency_vs_1worker")
+        )
+        results["process_decode_ceiling_imgs_per_sec"] = round(ceiling, 1)
+        frac = at_cores[0]["images_per_sec"] / ceiling if ceiling else None
+        results["loader_fraction_of_process_ceiling"] = (
+            round(frac, 3) if frac else None
+        )
+        if frac:
+            print(f"pure {cores}-process decode ceiling: {ceiling:.1f} "
+                  f"img/s; loader at {cores} workers delivers "
+                  f"{frac:.2f}x of it")
+
+    # legacy headline fields (meaning unchanged: thread mode, 8 workers)
+    e2e = next(e["images_per_sec"] for e in sweep
+               if e["workers_mode"] == "thread" and e["workers"] == 8)
     results["loader_e2e_8workers_imgs_per_sec"] = round(e2e, 1)
     e2e_per_core = e2e / min(8, cores)
     results["loader_e2e_imgs_per_sec_per_core"] = round(e2e_per_core, 1)
@@ -169,10 +339,37 @@ def main():
         results["loader_e2e_fraction_of_raw"] = round(
             e2e_per_core / best_per_core, 3
         )
-    print(f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s "
-          f"({e2e_per_core / best_per_core:.2f}x raw decode/core)"
-          if best_per_core else
-          f"DataLoader end-to-end (8 workers): {e2e:.1f} img/s")
+    best_e2e = max(e["images_per_sec"] for e in sweep)
+    results["loader_best_imgs_per_sec"] = round(best_e2e, 1)
+
+    # decode-cache A/B (same interleaved rounds): cold = every item pays
+    # JPEG decode; warm = hits re-apply only crop/resize/flip. The
+    # process config is the headline combination: shm workers, each
+    # holding a warm per-worker shard of the budget.
+    cold = best[("thread", cache_workers, 0)]
+    warm = best[("thread", cache_workers, cache_budget)]
+    warm_pr = best[("process", cache_workers, cache_budget)]
+    warm_stats = cache_stats[("thread", cache_workers, cache_budget)]
+    warm_pr_stats = cache_stats[("process", cache_workers, cache_budget)]
+    results["cache_ab"] = {
+        "workers_mode": "thread", "workers": cache_workers,
+        "cache_mb": args.cache_mb,
+        "cold_images_per_sec": round(cold, 1),
+        "warm_images_per_sec": round(warm, 1),
+        "warm_hit_rate": round(warm_stats.get("cache_hit_rate", 0.0), 4),
+        "speedup_warm_over_cold": round(warm / cold, 3) if cold else None,
+        "per_image_ms_cold": round(1000.0 / cold, 3) if cold else None,
+        "per_image_ms_warm": round(1000.0 / warm, 3) if warm else None,
+        "warm_process_images_per_sec": round(warm_pr, 1),
+        "warm_process_hit_rate": round(
+            warm_pr_stats.get("cache_hit_rate", 0.0), 4
+        ),
+    }
+    print(f"decode cache ({cache_workers} threads, {args.cache_mb} MB): "
+          f"cold {cold:.1f} → warm {warm:.1f} img/s "
+          f"({warm / cold:.2f}x, hit rate "
+          f"{warm_stats.get('cache_hit_rate', 0.0):.2f}); "
+          f"process+cache {warm_pr:.1f} img/s")
 
     # the honest feedability bound: how many host cores one chip needs.
     # per-core decode rate is the scale-free number (thread scaling only
@@ -190,6 +387,16 @@ def main():
             f"{math.ceil(needed)} cores per chip "
             f"({'OK' if cores >= needed else 'NOT feedable'} with "
             f"{cores} core(s) here)"
+        )
+    # the same budget against the WARM cache rate: what a deployment
+    # needs once epoch-1 has filled the decode cache
+    warm_per_core = warm / min(cache_workers, cores) if warm else 0.0
+    if warm_per_core > 0:
+        needed_warm = args.chip_rate / warm_per_core
+        results["cores_needed_per_chip_cache_warm"] = round(needed_warm, 1)
+        print(
+            f"cache-warm: {warm_per_core:.1f} img/s/core → "
+            f"{math.ceil(needed_warm)} cores per chip"
         )
 
     with open(args.out, "w") as f:

@@ -50,6 +50,34 @@ def _normalized_inputs(n, image, seed=0):
     return rng.randn(n, image, image, 3).astype(np.float32)
 
 
+def make_zeros_template(model, image):
+    """Zero-filled ``{params, batch_stats}`` template with the model's
+    REAL leaf shapes/dtypes, built without materializing parameters
+    (``jax.eval_shape`` traces ``init`` abstractly).
+
+    Each leaf must be constructed as ``np.zeros(s.shape, s.dtype)`` —
+    ``np.zeros_like`` on a ``jax.ShapeDtypeStruct`` returns a 0-d OBJECT
+    array (numpy treats the struct as a scalar), which then fails
+    ``convert_state_dict``'s shape validation on the first key
+    (ADVICE.md r5; locked by tests/test_tv_template.py)."""
+    import jax
+
+    template = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype),
+        jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0),
+                np.zeros((1, image, image, 3), np.float32),
+                train=False,
+            )
+        ),
+    )
+    template = {k: template[k] for k in ("params", "batch_stats")
+                if k in template}
+    template.setdefault("batch_stats", {})
+    return template
+
+
 def _dptpu_logits(arch, variables, x_nhwc, image):
     import jax.numpy as jnp
 
@@ -75,8 +103,6 @@ def tv_parity(archs, n_inputs, image):
     from dptpu.models import create_model
     from dptpu.models.pretrained import convert_state_dict
 
-    import jax
-
     results = {}
     x = _normalized_inputs(n_inputs, image)
     for arch in archs:
@@ -89,19 +115,7 @@ def tv_parity(archs, n_inputs, image):
         sd = {k: v.numpy() for k, v in tv_model.state_dict().items()
               if hasattr(v, "numpy")}
         model = create_model(arch, num_classes=1000)
-        template = jax.tree_util.tree_map(
-            np.zeros_like,
-            jax.eval_shape(
-                lambda m=model: m.init(
-                    jax.random.PRNGKey(0),
-                    np.zeros((1, image, image, 3), np.float32),
-                    train=False,
-                )
-            ),
-        )
-        template = {k: template[k] for k in ("params", "batch_stats")
-                    if k in template}
-        template.setdefault("batch_stats", {})
+        template = make_zeros_template(model, image)
         variables = convert_state_dict(arch, sd, template)
         got = _dptpu_logits(arch, variables, x, image)
         dl = np.abs(got - want)

@@ -32,6 +32,7 @@ import pytest  # noqa: E402
 _FAST_MODULES = {
     "test_bench_logic", "test_config", "test_schedules", "test_metrics",
     "test_meters", "test_data", "test_tensorboard", "test_native",
+    "test_cache", "test_shm_loader", "test_feed_knobs", "test_tv_template",
 }
 
 

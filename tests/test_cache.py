@@ -1,0 +1,121 @@
+"""DecodeCache: byte-budget/LRU semantics + decoded-pixel reuse parity.
+
+The cache's correctness contract (dptpu/data/cache.py): a hit and a miss
+produce IDENTICAL pixels for identical augmentation RNG — both resample
+the same decoded buffer — so cache warmth never changes what a seeded
+run trains on. Fixtures are 52×44 JPEGs (< 48·8/7): the native scale
+picker stays at 8/8, making cache-ON vs cache-OFF bit-exact as well (for
+larger images the cached path resamples from strictly higher-resolution
+source pixels — documented, not asserted here).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dptpu.data import (
+    DataLoader,
+    DecodeCache,
+    ImageFolderDataset,
+    train_transform,
+    val_transform,
+)
+
+
+@pytest.fixture(scope="module")
+def jpeg_folder(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cachejpeg")
+    rng = np.random.RandomState(1)
+    for cls in ["c0", "c1"]:
+        d = root / cls
+        d.mkdir()
+        for i in range(6):
+            low = rng.randint(0, 255, (8, 7, 3), np.uint8)
+            img = Image.fromarray(low).resize((52, 44), Image.BILINEAR)
+            img.save(str(d / f"{i}.jpg"), quality=85)
+    return str(root)
+
+
+def test_eviction_respects_byte_budget():
+    c = DecodeCache(1000)
+    for k in range(10):
+        assert c.put(k, np.zeros(300, np.uint8))
+        assert c.bytes_in_use <= 1000  # invariant holds at every step
+    assert len(c) == 3
+    assert c.stats()["cache_evictions"] == 7
+    assert c.get(0) is None  # LRU evicted ...
+    assert c.get(9) is not None  # ... newest retained
+
+
+def test_oversize_entry_rejected_not_cached():
+    c = DecodeCache(100)
+    assert c.put("big", np.zeros(101, np.uint8)) is False
+    assert len(c) == 0 and c.bytes_in_use == 0
+
+
+def test_lru_recency_order():
+    c = DecodeCache(900)
+    for k in range(3):
+        c.put(k, np.zeros(300, np.uint8))
+    assert c.get(0) is not None  # touch 0 → MRU
+    c.put(3, np.zeros(300, np.uint8))  # must evict 1 (now LRU), not 0
+    assert c.get(1) is None
+    assert c.get(0) is not None
+
+
+def test_pickle_carries_budget_not_contents():
+    c = DecodeCache(1000)
+    c.put("x", np.zeros(10, np.uint8))
+    c2 = pickle.loads(pickle.dumps(c))
+    assert len(c2) == 0 and c2.budget_bytes == 1000
+    c2.scale_budget(4)
+    assert c2.budget_bytes == 250
+    with pytest.raises(ValueError):
+        DecodeCache(0)
+
+
+def test_cache_on_off_pixel_parity_and_hit_accounting(jpeg_folder):
+    off = ImageFolderDataset(jpeg_folder, train_transform(48))
+    on = ImageFolderDataset(jpeg_folder, train_transform(48),
+                            cache_bytes=32 << 20)
+    n = len(off)
+    for epoch in (0, 1, 2):
+        for i in range(n):
+            a, la = off.get(i, np.random.default_rng([7, epoch, i]))
+            b, lb = on.get(i, np.random.default_rng([7, epoch, i]))
+            assert la == lb
+            np.testing.assert_array_equal(a, b)
+    st = on.decode_cache.stats()
+    assert st["cache_misses"] == n  # epoch 0 fills
+    assert st["cache_hits"] == 2 * n  # epochs 1-2 skip JPEG decode
+    assert st["cache_bytes_in_use"] > 0
+
+
+def test_val_pipeline_cache_parity(jpeg_folder):
+    """ValTransform vetoes the native path; the cached PIL decode re-runs
+    the exact transform on the exact full-res pixels — bit-identical
+    unconditionally."""
+    off = ImageFolderDataset(jpeg_folder, val_transform(32, resize=40))
+    on = ImageFolderDataset(jpeg_folder, val_transform(32, resize=40),
+                            cache_bytes=32 << 20)
+    for _ in range(2):
+        for i in range(len(off)):
+            np.testing.assert_array_equal(off.get(i)[0], on.get(i)[0])
+    assert on.decode_cache.stats()["cache_hits"] == len(off)
+
+
+def test_thread_loader_feed_stats_report_cache(jpeg_folder):
+    ds = ImageFolderDataset(jpeg_folder, train_transform(48),
+                            cache_bytes=32 << 20)
+    loader = DataLoader(ds, 4, num_workers=2, seed=1)
+    try:
+        list(loader.epoch(0))
+        list(loader.epoch(1))
+        fs = loader.feed_stats()
+        assert fs["workers_mode"] == "thread"
+        assert fs["num_workers"] == 2
+        assert fs["cache_hit_rate"] > 0.4  # epoch 1 ran warm
+    finally:
+        loader.close()

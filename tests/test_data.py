@@ -199,6 +199,52 @@ def test_device_prefetcher_preserves_order():
     loader.close()
 
 
+def test_loader_fixed_shape_contract_raises_clearly(tmp_path):
+    """Without a sizing transform, mixed image sizes violate the
+    fixed-shape contract: the loader must name the offending sample and
+    the contract, not die with a numpy broadcast error."""
+    d = tmp_path / "train" / "c0"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    Image.fromarray(rng.randint(0, 256, (40, 52, 3), dtype=np.uint8)).save(
+        d / "a_first.png"
+    )
+    Image.fromarray(rng.randint(0, 256, (30, 20, 3), dtype=np.uint8)).save(
+        d / "b_second.png"
+    )
+    ds = ImageFolderDataset(str(tmp_path / "train"))  # transform=None
+    loader = DataLoader(ds, batch_size=2, num_workers=1)
+    with pytest.raises(ValueError, match="decoded to shape"):
+        list(loader.epoch(0))
+    loader.close()
+
+
+def test_loader_probe_decode_reused_for_first_row():
+    """The shape probe's decode is reused for its sample's batch row
+    (ADVICE r5): sample 0 must be loaded exactly once per first epoch,
+    and the reuse must not change the yielded pixels."""
+
+    class Counting(SyntheticDataset):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.loads = {}
+
+        def get(self, index, rng=None):
+            self.loads[index] = self.loads.get(index, 0) + 1
+            return super().get(index, rng)
+
+        # force the get()-based path so every decode is counted
+        get_into = None
+
+    ds = Counting(num_samples=8, image_size=8, num_classes=4)
+    loader = DataLoader(ds, batch_size=4, num_workers=2, seed=3)
+    batches = list(loader.epoch(0))
+    assert ds.loads[0] == 1  # probed once, reused — not decoded twice
+    ref = SyntheticDataset(num_samples=8, image_size=8, num_classes=4)
+    np.testing.assert_array_equal(batches[0]["images"][0], ref.get(0)[0])
+    loader.close()
+
+
 def test_val_transform_matches_torchvision_two_step_exactly():
     """The fused one-box val resample must be PIXEL-EXACT (±1 LSB of
     uint8 rounding) to torchvision's two-step Resize(256)→CenterCrop(224)

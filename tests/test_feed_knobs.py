@@ -1,0 +1,59 @@
+"""The locked fail-fast env-knob contract, input-pipeline edition.
+
+Every explicitly-set-but-invalid knob value must raise with an
+actionable message — including the previously-silent ``DPTPU_TP=0`` /
+``DPTPU_SP=0`` (ADVICE r5: 0 was the one value that got neither the
+no-op notice nor the error).
+"""
+
+import pytest
+
+from dptpu.train.fit import _axis_env_knob, _feed_knobs, _os_environ_int
+
+
+def test_unset_knob_is_none_then_off(monkeypatch):
+    monkeypatch.delenv("DPTPU_TP", raising=False)
+    assert _os_environ_int("DPTPU_TP") is None
+    assert _axis_env_knob("DPTPU_TP", "model-axis size") == 0
+
+
+def test_axis_zero_raises_like_negatives(monkeypatch):
+    for bad in ("0", "-2"):
+        monkeypatch.setenv("DPTPU_TP", bad)
+        with pytest.raises(ValueError, match="DPTPU_TP"):
+            _axis_env_knob("DPTPU_TP", "model-axis size")
+    monkeypatch.setenv("DPTPU_SP", "0")
+    with pytest.raises(ValueError, match="DPTPU_SP"):
+        _axis_env_knob("DPTPU_SP", "seq-axis size")
+
+
+def test_axis_junk_raises(monkeypatch):
+    monkeypatch.setenv("DPTPU_TP", "two")
+    with pytest.raises(ValueError, match="not an integer"):
+        _axis_env_knob("DPTPU_TP", "model-axis size")
+
+
+def test_feed_knobs_defaults_and_validation(monkeypatch):
+    monkeypatch.delenv("DPTPU_WORKERS_MODE", raising=False)
+    monkeypatch.delenv("DPTPU_CACHE_BYTES", raising=False)
+    assert _feed_knobs() == ("thread", 0)
+
+    monkeypatch.setenv("DPTPU_WORKERS_MODE", "process")
+    monkeypatch.setenv("DPTPU_CACHE_BYTES", str(1 << 20))
+    assert _feed_knobs() == ("process", 1 << 20)
+
+    monkeypatch.setenv("DPTPU_CACHE_BYTES", "0")  # explicit off is valid
+    assert _feed_knobs() == ("process", 0)
+
+    monkeypatch.setenv("DPTPU_WORKERS_MODE", "gevent")
+    with pytest.raises(ValueError, match="DPTPU_WORKERS_MODE"):
+        _feed_knobs()
+
+    monkeypatch.setenv("DPTPU_WORKERS_MODE", "thread")
+    monkeypatch.setenv("DPTPU_CACHE_BYTES", "-1")
+    with pytest.raises(ValueError, match="DPTPU_CACHE_BYTES"):
+        _feed_knobs()
+
+    monkeypatch.setenv("DPTPU_CACHE_BYTES", "lots")
+    with pytest.raises(ValueError, match="not an integer"):
+        _feed_knobs()

@@ -1,0 +1,126 @@
+"""Process-mode (shared-memory ring) loader: parity, cache, errors.
+
+The contract under test (dptpu/data/shm.py + loader.py): for the same
+``(seed, epoch, index)`` RNG, ``workers_mode="process"`` must yield
+BATCHES BIT-IDENTICAL to thread mode — same pixels, labels, pad/mask
+semantics — because workers run the exact same span-decode path, only
+into shared memory instead of a same-process array. A worker decode
+error must surface as a parent-side exception carrying the worker's
+traceback, never a hang.
+
+JPEG fixtures are 52×44 (< 48·8/7): the native scale picker then stays
+at full resolution, which also makes cache-on/off comparisons bit-exact
+(see ImageFolderDataset docstring).
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dptpu.data import (
+    DataLoader,
+    ImageFolderDataset,
+    train_transform,
+)
+
+
+@pytest.fixture(scope="module")
+def jpeg_folder(tmp_path_factory):
+    root = tmp_path_factory.mktemp("shmjpeg")
+    rng = np.random.RandomState(0)
+    for cls in ["c0", "c1"]:
+        d = root / cls
+        d.mkdir()
+        for i in range(9):
+            low = rng.randint(0, 255, (8, 7, 3), np.uint8)
+            img = Image.fromarray(low).resize((52, 44), Image.BILINEAR)
+            img.save(str(d / f"{i}.jpg"), quality=85)
+    return str(root)
+
+
+class CrashAtFive:
+    """Decode-error fixture — module level so spawn can pickle it."""
+
+    def __len__(self):
+        return 12
+
+    def get(self, index, rng=None):
+        if index == 5:
+            raise ValueError("decode exploded on sample 5")
+        return np.full((8, 8, 3), index, np.uint8), index
+
+    def get_into(self, index, rng, out):
+        img, lab = self.get(index, rng)
+        np.copyto(out, img)
+        return lab
+
+    def __getitem__(self, index):
+        return self.get(index)
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x["images"], y["images"])
+        np.testing.assert_array_equal(x["labels"], y["labels"])
+        assert ("mask" in x) == ("mask" in y)
+        if "mask" in x:
+            np.testing.assert_array_equal(x["mask"], y["mask"])
+
+
+def test_process_loader_bit_identical_to_thread(jpeg_folder):
+    ds = ImageFolderDataset(jpeg_folder, train_transform(48))  # 18 samples
+    th = DataLoader(ds, 4, num_workers=2, seed=5)
+    pr = DataLoader(ds, 4, num_workers=2, seed=5, workers_mode="process")
+    try:
+        for epoch in (0, 1):
+            a, b = list(th.epoch(epoch)), list(pr.epoch(epoch))
+            assert len(a) == 5  # ceil(18/4): padded+masked tail included
+            _assert_batches_equal(a, b)
+        # abandoning an epoch mid-flight must not wedge the slot ring
+        it = pr.epoch(2)
+        next(it)
+        del it
+        _assert_batches_equal(list(th.epoch(3)), list(pr.epoch(3)))
+    finally:
+        th.close()
+        pr.close()
+
+
+def test_process_loader_cache_parity_and_stats(jpeg_folder):
+    """Per-worker decode caches change nothing about the pixels (hit and
+    miss resample the same decoded buffer) and aggregate into
+    ``feed_stats`` through the done-message piggyback."""
+    ds_th = ImageFolderDataset(jpeg_folder, train_transform(48),
+                               cache_bytes=32 << 20)
+    ds_pr = ImageFolderDataset(jpeg_folder, train_transform(48),
+                               cache_bytes=32 << 20)
+    th = DataLoader(ds_th, 4, num_workers=2, seed=5)
+    pr = DataLoader(ds_pr, 4, num_workers=2, seed=5,
+                    workers_mode="process")
+    try:
+        for epoch in (0, 1):
+            _assert_batches_equal(list(th.epoch(epoch)),
+                                  list(pr.epoch(epoch)))
+        fs = pr.feed_stats()
+        assert fs["workers_mode"] == "process"
+        assert fs["cache_hits"] > 0
+        assert 0.0 < fs["cache_hit_rate"] <= 1.0
+    finally:
+        th.close()
+        pr.close()
+
+
+def test_worker_decode_error_propagates_with_traceback():
+    loader = DataLoader(CrashAtFive(), 4, num_workers=2, seed=0,
+                        workers_mode="process")
+    try:
+        with pytest.raises(RuntimeError, match="decode exploded on sample 5"):
+            list(loader.epoch(0))
+    finally:
+        loader.close()
+
+
+def test_invalid_workers_mode_rejected():
+    with pytest.raises(ValueError, match="workers_mode"):
+        DataLoader(CrashAtFive(), 4, workers_mode="greenlet")

@@ -1,0 +1,54 @@
+"""Template construction for the torchvision parity harness (ADVICE r5).
+
+``tree_map(np.zeros_like, jax.eval_shape(...))`` yields 0-d OBJECT
+arrays (numpy treats a ShapeDtypeStruct as a scalar), which made the
+published-weights parity section crash wherever torch actually exists.
+``make_zeros_template`` must produce real zero arrays with the model's
+leaf shapes/dtypes — locked here so the fix can't regress unnoticed.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+import check_tv_parity  # noqa: E402
+
+
+def test_make_zeros_template_builds_real_arrays():
+    import jax
+
+    from dptpu.models import create_model
+
+    model = create_model("resnet18", num_classes=10)
+    template = check_tv_parity.make_zeros_template(model, 32)
+
+    assert set(template) == {"params", "batch_stats"}
+    leaves = jax.tree_util.tree_leaves(template)
+    assert leaves
+    for leaf in leaves:
+        assert isinstance(leaf, np.ndarray)
+        assert leaf.dtype != np.dtype(object)  # the regression mode
+        assert leaf.ndim >= 1  # 0-d scalars were the crash
+
+    # shapes/dtypes agree leaf-for-leaf with an abstract init
+    want = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 3), np.float32),
+            train=False,
+        )
+    )
+    want = {k: want[k] for k in ("params", "batch_stats") if k in want}
+    got_shapes = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), template)
+    want_shapes = jax.tree_util.tree_map(
+        lambda s: (s.shape, s.dtype), want
+    )
+    assert got_shapes == want_shapes
