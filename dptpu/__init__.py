@@ -16,7 +16,7 @@ multi-host pod scale), redesigned TPU-first:
   fused uint8→bf16 normalization.
 
 Subpackages: ``config``, ``models``, ``ops``, ``data``, ``parallel``,
-``train``, ``utils``, ``cli``, ``native``.
+``train``, ``resilience``, ``utils``, ``cli``, ``native``.
 """
 
 __version__ = "0.1.0"

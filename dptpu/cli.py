@@ -5,22 +5,60 @@ from dptpu.config import parse_config
 from dptpu.train import fit
 
 
+def _report_preemption(result):
+    """A graceful preemption is a SUCCESS (exit 0): the mid-epoch
+    checkpoint is on disk and a ``--resume`` run replays the sampler to
+    the exact saved position (bit-identical trajectory — see
+    dptpu/resilience)."""
+    if result.get("preempted"):
+        print(
+            "preempted: mid-epoch checkpoint saved; rerun with "
+            "--resume <run dir> to continue where this run stopped"
+        )
+
+
 def main_ddp(argv=None):
     """imagenet_ddp.py: multi-host data-parallel training."""
     cfg = parse_config(argv, variant="ddp")
     result = fit(cfg)
     if result.get("early_stopped"):
         print(f"early stop: training_time {result['training_time']:.1f}s")
+    _report_preemption(result)
     return result
 
 
 def main_nd(argv=None):
     """nd_imagenet.py: single-device / fallback-everything training."""
     cfg = parse_config(argv, variant="nd")
-    return fit(cfg)
+    result = fit(cfg)
+    _report_preemption(result)
+    return result
 
 
 def main_apex(argv=None):
     """imagenet_ddp_apex.py: bf16 mixed-precision training (env:// rendezvous)."""
     cfg = parse_config(argv, variant="apex").replace(dist_url="env://")
-    return fit(cfg)
+    result = fit(cfg)
+    _report_preemption(result)
+    return result
+
+
+# Installed-command wrappers (pyproject [project.scripts]): setuptools
+# wraps an entry point as ``sys.exit(fn())``, and ``sys.exit(<dict>)``
+# exits 1 — which would break the exit-0 contract graceful preemption
+# (and every successful run) depends on. The repo-root scripts and tests
+# keep calling the result-returning ``main_*`` directly.
+
+def console_ddp(argv=None) -> int:
+    main_ddp(argv)
+    return 0
+
+
+def console_nd(argv=None) -> int:
+    main_nd(argv)
+    return 0
+
+
+def console_apex(argv=None) -> int:
+    main_apex(argv)
+    return 0

@@ -61,6 +61,11 @@ class Config:
     resume: str = ""
     evaluate: bool = False
     pretrained: bool = False
+    # resilience (dptpu extension, all variants): mid-epoch checkpoint
+    # cadence + rotation depth (dptpu/resilience). 0 = epoch-boundary
+    # saves only, the reference's behavior (imagenet_ddp.py:216-222).
+    ckpt_steps: int = 0
+    ckpt_keep: int = 3
     # distributed (ddp/nd; apex uses env:// exclusively)
     world_size: int = -1
     rank: int = -1
@@ -132,7 +137,18 @@ def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentPar
                    metavar="W", dest="weight_decay")
     p.add_argument("-p", "--print-freq", default=10, type=int, metavar="N")
     p.add_argument("--resume", default="", type=str, metavar="PATH",
-                   help="path to latest checkpoint")
+                   help="path to latest checkpoint — a FILE (used if it "
+                        "verifies; corrupt files fall back to the newest "
+                        "verifiable sibling) or a DIRECTORY to scan")
+    # dptpu resilience extension (not a reference flag): preemption-safe
+    # mid-epoch checkpoints; resume replays the deterministic sampler to
+    # the saved (epoch, step) so the trajectory stays bit-identical
+    p.add_argument("--ckpt-steps", default=0, type=int, metavar="N",
+                   help="also save a rotated mid-epoch checkpoint every N "
+                        "steps (0 disables; SIGTERM/SIGINT always trigger "
+                        "one final mid-epoch save)")
+    p.add_argument("--ckpt-keep", default=3, type=int, metavar="K",
+                   help="how many rotated mid-epoch checkpoints to keep")
     p.add_argument("-e", "--evaluate", dest="evaluate", action="store_true",
                    help="evaluate model on validation set")
     p.add_argument("--pretrained", dest="pretrained", action="store_true")

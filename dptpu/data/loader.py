@@ -84,6 +84,8 @@ class DataLoader:
         self._probe = None  # (index, epoch, img, label) — reused for row 0
         self._pipeline = None  # lazy shm ring (process mode)
         self._prev_cache_counts = (0, 0)  # feed_stats interval baseline
+        self._degraded = False  # process pool gave up → thread fallback
+        self._supervision = {"pool_restarts": 0, "span_retries": 0}
         self._pool = (
             ThreadPoolExecutor(
                 max_workers=self.num_workers, thread_name_prefix="dptpu-data"
@@ -176,14 +178,31 @@ class DataLoader:
             batch["mask"] = mask
         return batch
 
-    def epoch(self, epoch: int = 0, prefetch_batches: int = 2) -> Iterator[dict]:
+    def epoch(self, epoch: int = 0, prefetch_batches: int = 2,
+              start_batch: int = 0) -> Iterator[dict]:
         """Iterate one epoch's batches (``epoch`` reseeds the shuffle —
-        the set_epoch analog, imagenet_ddp.py:202)."""
+        the set_epoch analog, imagenet_ddp.py:202).
+
+        ``start_batch`` replays the sampler to a mid-epoch resume point
+        (dptpu.resilience): the FULL epoch permutation is rebuilt from
+        ``(seed, epoch)`` exactly as an uninterrupted run would, then the
+        first ``start_batch`` batches are skipped WITHOUT decoding — the
+        remaining batches are bit-identical to what the uninterrupted
+        epoch would have yielded from that position.
+        """
         indices, valid = self.sampler.indices_and_validity(epoch)
         nb = len(self)
         sl = lambda b: slice(b * self.batch_size, (b + 1) * self.batch_size)  # noqa: E731
         chunks = [(indices[sl(b)], valid[sl(b)]) for b in range(nb)]
-        if self._item_shape is None and nb:
+        if start_batch:
+            if not 0 <= start_batch <= nb:
+                raise ValueError(
+                    f"start_batch={start_batch} outside this epoch's "
+                    f"[0, {nb}] batches — checkpoint from a different "
+                    f"batch size or dataset?"
+                )
+            chunks = chunks[start_batch:]
+        if self._item_shape is None and chunks:
             # one probe decode fixes the item shape for preallocation
             # (cached on the loader; only the first epoch() call pays —
             # and thread mode reuses the decode for the sample's row)
@@ -197,6 +216,12 @@ class DataLoader:
         if self.workers_mode == "process":
             yield from self._epoch_process(chunks, epoch, ahead)
             return
+        yield from self._epoch_thread(chunks, epoch, ahead)
+
+    def _epoch_thread(self, chunks, epoch, ahead):
+        """Thread-pool epoch over an explicit chunk list (also the landing
+        path when a broken process pool degrades mid-epoch)."""
+        nb = len(chunks)
         pending = deque()
         for chunk, _ in chunks[:ahead]:
             pending.append(self._submit_batch(chunk, epoch))
@@ -211,33 +236,79 @@ class DataLoader:
     def _epoch_process(self, chunks, epoch, ahead):
         """Process-mode epoch: drive the shared-memory slot ring
         (dptpu/data/shm.py) with the same submit-ahead/collect-in-order
-        cadence as the thread path."""
+        cadence as the thread path. If the supervised pool exhausts its
+        restart budget (``WorkerPoolBroken``), degrade to thread mode for
+        the rest of the run instead of killing the job — batches are
+        bit-identical between modes, so the hand-off is seamless."""
+        from dptpu.data.shm import WorkerPoolBroken
+
         if not chunks:
             return
         self._probe = None  # workers decode row 0 themselves
-        pipe = self._ensure_pipeline(slots=ahead + 1)
-        pipe.reset()  # reclaim slots from an abandoned prior epoch
         nb = len(chunks)
-        pending = deque()
-        for chunk, _ in chunks[:ahead]:
-            pending.append(pipe.submit(chunk, epoch))
-        next_idx = ahead
-        for b in range(nb):
-            slot, n_valid = pending.popleft()
-            if next_idx < nb:
-                pending.append(pipe.submit(chunks[next_idx][0], epoch))
-                next_idx += 1
-            out_size = self.batch_size if self.pad_final else n_valid
-            imgs, labels = pipe.collect(slot, out_size)
-            yield self._assemble(imgs, labels, n_valid, valid=chunks[b][1])
+        b = 0
+        try:
+            pipe = self._ensure_pipeline(slots=ahead + 1)
+            pipe.reset()  # reclaim slots from an abandoned prior epoch
+            pending = deque()
+            for chunk, _ in chunks[:ahead]:
+                pending.append(pipe.submit(chunk, epoch))
+            next_idx = ahead
+            for b in range(nb):
+                slot, n_valid = pending.popleft()
+                if next_idx < nb:
+                    pending.append(pipe.submit(chunks[next_idx][0], epoch))
+                    next_idx += 1
+                out_size = self.batch_size if self.pad_final else n_valid
+                imgs, labels = pipe.collect(slot, out_size)
+                yield self._assemble(imgs, labels, n_valid,
+                                     valid=chunks[b][1])
+        except WorkerPoolBroken as e:
+            self._degrade_to_thread(str(e))
+            # batch b was never yielded; re-decode from it on threads
+            yield from self._epoch_thread(chunks[b:], epoch, ahead)
+
+    def _retire_pipeline(self):
+        """Close the pipeline, folding its supervision counters into the
+        loader's base first — feed_stats' survive-rebuilds invariant has
+        exactly one implementation."""
+        if self._pipeline is not None:
+            for k, v in self._pipeline.supervision_stats().items():
+                self._supervision[k] += v
+            self._pipeline.close()
+            self._pipeline = None
+
+    def _degrade_to_thread(self, reason: str):
+        """Graceful degradation: give up on worker processes for the rest
+        of this run, loudly, instead of dying mid-job."""
+        import sys
+
+        print(
+            f"WARNING: dptpu process-mode data pipeline is degrading to "
+            f"thread mode (slower, but alive): {reason}",
+            file=sys.stderr,
+        )
+        self._retire_pipeline()
+        self.workers_mode = "thread"
+        self._degraded = True
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.num_workers, thread_name_prefix="dptpu-data"
+            )
+
+    def kill_one_worker(self):
+        """Fault-injection/debug hook (``DPTPU_FAULT=worker_kill@step=N``):
+        SIGKILL one live decode worker; no-op in thread mode."""
+        if self._pipeline is not None:
+            return self._pipeline.kill_worker()
+        return None
 
     def _ensure_pipeline(self, slots: int):
         from dptpu.data.shm import ShmBatchPipeline
 
         if self._pipeline is not None and self._pipeline.slots < slots:
             # prefetch depth grew between epochs: rebuild the ring
-            self._pipeline.close()
-            self._pipeline = None
+            self._retire_pipeline()
         if self._pipeline is None:
             self._pipeline = ShmBatchPipeline(
                 self.dataset, self.batch_size, self._item_shape,
@@ -262,6 +333,17 @@ class DataLoader:
             "workers_mode": self.workers_mode,
             "num_workers": self.num_workers,
         }
+        # supervision counters survive pool rebuilds and degradation:
+        # the loader folds closed pipelines' totals into its own base
+        restarts = dict(self._supervision)
+        if self._pipeline is not None:
+            for k, v in self._pipeline.supervision_stats().items():
+                restarts[k] += v
+        if restarts["pool_restarts"] or restarts["span_retries"] \
+                or self._degraded:
+            stats.update(restarts)
+        if self._degraded:
+            stats["degraded"] = True
         if self.workers_mode == "process":
             if self._pipeline is not None:
                 stats.update(self._pipeline.cache_stats())
@@ -281,9 +363,7 @@ class DataLoader:
     def close(self):
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
-        if self._pipeline is not None:
-            self._pipeline.close()
-            self._pipeline = None
+        self._retire_pipeline()
 
 
 class DevicePrefetcher:

@@ -7,6 +7,14 @@ padded by wrap-around so every shard draws the same number of samples, and
 the permutation is reseeded from ``(seed, epoch)`` — the
 ``train_sampler.set_epoch(epoch)`` analog (imagenet_ddp.py:202) made
 explicit: ``epoch`` is an argument, not mutable sampler state.
+
+This purity is also the RESILIENCE contract (dptpu/resilience): because
+the whole epoch permutation is a function of ``(seed, epoch)`` alone —
+no consumed-iterator state — any mid-epoch position is replayable after a
+preemption. A checkpoint only needs ``(epoch, step_in_epoch)``; the
+resumed ``DataLoader.epoch(epoch, start_batch=step_in_epoch)`` rebuilds
+the identical permutation and skips forward, so the batches (and with
+them the loss trajectory) match the uninterrupted run bit for bit.
 """
 
 from __future__ import annotations

@@ -19,11 +19,24 @@ loader's preallocated batch memory itself:
 * per-item augmentation RNG is derived from ``(seed, epoch, index)``
   exactly as in thread mode, so process and thread loaders yield
   BIT-IDENTICAL batches for the same seed (tests/test_shm_loader.py);
-* a decode error in a worker is caught, carried back as a traceback
-  string, and re-raised in the parent with context — never a hang;
 * the parent copies a completed slot out once (so consumers own their
   batches and the slot recycles immediately); that single memcpy is
   ~1-2 ms against a >100 ms decode per batch.
+
+SUPERVISION (dptpu.resilience): the pool is watched, not trusted. Every
+result wait runs under a deadline (``DPTPU_WORKER_TIMEOUT_S``); a dead
+worker (OOM-kill, native crash, SIGKILL) or a silent hang triggers a pool
+restart — workers are killed, queues rebuilt, and every UNACKED span
+re-enqueued, which is safe because spans are deterministic pure writes
+into disjoint rows (re-decoding produces the same bytes). A span that
+ERRORS is retried ``DPTPU_SPAN_RETRIES`` times (covers transient I/O)
+before the worker's traceback is re-raised in the parent. After
+``DPTPU_POOL_RESTARTS`` CONSECUTIVE restarts without progress the pool
+raises :class:`WorkerPoolBroken`, and the DataLoader degrades to thread
+mode with a loud warning instead of killing a multi-hour job. An
+``atexit`` hook unlinks the SharedMemory segments of any pipeline the
+parent abandons without ``close()`` (an aborted run must not leak
+``/dev/shm`` until reboot).
 
 Workers are spawned (not forked) by default: the parent holds JAX/XLA
 runtime threads whose locks must not be forked mid-flight. Spawn pickles
@@ -34,11 +47,44 @@ size — see ``dptpu/data/cache.py``).
 
 from __future__ import annotations
 
+import atexit
 import queue as _queue
+import sys
+import time
 import traceback
+import weakref
 from typing import Optional, Tuple
 
 import numpy as np
+
+from dptpu.envknob import env_float, env_int
+from dptpu.resilience.faults import FaultPlan
+
+_LIVE_PIPELINES: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_REGISTERED = False
+
+
+def _atexit_close_all():
+    """Unlink shared-memory segments of pipelines the parent never closed
+    (otherwise an aborted run leaks /dev/shm until reboot)."""
+    for pipe in list(_LIVE_PIPELINES):
+        try:
+            pipe.close()
+        except Exception:
+            pass
+
+
+def _register_pipeline(pipe):
+    global _ATEXIT_REGISTERED
+    _LIVE_PIPELINES.add(pipe)
+    if not _ATEXIT_REGISTERED:
+        atexit.register(_atexit_close_all)
+        _ATEXIT_REGISTERED = True
+
+
+class WorkerPoolBroken(RuntimeError):
+    """The pool failed ``max_restarts`` consecutive times — the caller
+    should degrade to thread mode rather than keep flogging it."""
 
 
 def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
@@ -67,6 +113,12 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
         cache.scale_budget(num_workers)
     get_into = getattr(dataset, "get_into", None)
     get = getattr(dataset, "get", None)
+    # worker-side fault injection (io_error / worker_hang) re-parses the
+    # inherited DPTPU_FAULT env — nothing fault-related crosses the pickle
+    try:
+        fault_plan = FaultPlan.from_env()
+    except ValueError:
+        fault_plan = None  # the parent raises the parse error loudly
     try:
         while True:
             task = task_q.get()
@@ -75,6 +127,8 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
             slot, offset, idxs, epoch = task
             try:
                 for j, index in enumerate(idxs):
+                    if fault_plan is not None:
+                        fault_plan.worker_decode_hook(worker_id, index)
                     rng = np.random.default_rng([seed, epoch, index])
                     row = imgs[slot, offset + j]
                     if get_into is not None:
@@ -89,9 +143,11 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
                         _copy_checked(row, img, index)
                         labels[slot, offset + j] = lab
                 hits, misses = (cache.hits, cache.misses) if cache else (0, 0)
-                res_q.put(("done", worker_id, slot, hits, misses))
+                res_q.put(("done", worker_id, slot, offset, hits, misses))
             except BaseException:
-                res_q.put(("error", worker_id, slot, traceback.format_exc()))
+                res_q.put(
+                    ("error", worker_id, slot, offset, traceback.format_exc())
+                )
     except (EOFError, OSError, KeyboardInterrupt):
         pass  # parent went away / interrupt: exit quietly
     finally:
@@ -102,18 +158,25 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
 
 class ShmBatchPipeline:
     """The process-mode backend of ``DataLoader``: shared-memory slot ring
-    + persistent worker pool + span task/ack queues.
+    + supervised persistent worker pool + span task/ack queues.
 
     Protocol (driven by ``DataLoader._epoch_process``): ``submit`` fans a
     batch's indices out as one span task per worker into a free slot;
     ``collect`` blocks until that slot's spans are acked, copies the rows
     out, and recycles the slot. ``reset`` drains an abandoned epoch's
     in-flight work so the ring starts an epoch fully free.
+
+    Supervision bookkeeping: ``_pending[slot][offset] = task`` holds every
+    unacked span — exactly what a pool restart must re-enqueue; it is the
+    single source of truth for "work the consumer is still owed".
     """
 
     def __init__(self, dataset, batch_size: int, item_shape: Tuple[int, ...],
                  num_workers: int, seed: int, slots: int,
-                 mp_start: str = "spawn"):
+                 mp_start: str = "spawn",
+                 timeout_s: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 span_retries: Optional[int] = None):
         import multiprocessing as mp
         from multiprocessing import shared_memory
 
@@ -121,9 +184,33 @@ class ShmBatchPipeline:
         self.item_shape = tuple(int(d) for d in item_shape)
         self.num_workers = max(1, num_workers)
         self.slots = max(2, slots)
+        self._dataset = dataset
+        self._seed = seed
         self._has_cache = getattr(dataset, "decode_cache", None) is not None
+        # supervision knobs (ctor beats env beats default)
+        self.timeout_s = (
+            timeout_s if timeout_s is not None
+            else env_float("DPTPU_WORKER_TIMEOUT_S", 120.0)
+        )
+        self.max_restarts = (
+            max_restarts if max_restarts is not None
+            else env_int("DPTPU_POOL_RESTARTS", 3)
+        )
+        self.span_retries = (
+            span_retries if span_retries is not None
+            else env_int("DPTPU_SPAN_RETRIES", 2)
+        )
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"DPTPU_WORKER_TIMEOUT_S={self.timeout_s} must be > 0 "
+                f"seconds"
+            )
+        if self.max_restarts < 0 or self.span_retries < 0:
+            raise ValueError(
+                "DPTPU_POOL_RESTARTS and DPTPU_SPAN_RETRIES must be >= 0"
+            )
         item_bytes = int(np.prod(self.item_shape))
-        ctx = mp.get_context(mp_start)
+        self._ctx = mp.get_context(mp_start)
         self._shm_imgs = shared_memory.SharedMemory(
             create=True, size=max(1, self.slots * batch_size * item_bytes)
         )
@@ -137,18 +224,30 @@ class ShmBatchPipeline:
         self._labels = np.ndarray(
             (self.slots, batch_size), np.int32, buffer=self._shm_labels.buf
         )
-        self._task_q = ctx.Queue()
-        self._res_q = ctx.Queue()
         self._outstanding = [0] * self.slots  # span acks still in flight
+        self._pending = {s: {} for s in range(self.slots)}  # offset -> task
+        self._retries = {}  # (slot, offset) -> attempts so far
         self._free = list(range(self.slots))
         self._worker_cache = {}  # worker_id -> latest (hits, misses)
+        self._restarts_total = 0
+        self._span_retries_total = 0
+        self._consec_failures = 0
         self._closed = False
+        self._start_workers()
+        _register_pipeline(self)
+
+    def _start_workers(self):
+        """(Re)create the task/ack queues and spawn the worker pool —
+        queues are rebuilt with the pool because a SIGKILLed worker can
+        leave a queue's internal pipe in a torn state."""
+        self._task_q = self._ctx.Queue()
+        self._res_q = self._ctx.Queue()
         self._procs = [
-            ctx.Process(
+            self._ctx.Process(
                 target=_worker_main,
-                args=(wid, dataset, self._shm_imgs.name,
-                      self._shm_labels.name, self.slots, batch_size,
-                      self.item_shape, seed, self.num_workers,
+                args=(wid, self._dataset, self._shm_imgs.name,
+                      self._shm_labels.name, self.slots, self.batch_size,
+                      self.item_shape, self._seed, self.num_workers,
                       self._task_q, self._res_q),
                 daemon=True,
                 name=f"dptpu-data-{wid}",
@@ -172,59 +271,185 @@ class ShmBatchPipeline:
         slot = self._free.pop()
         n = len(batch_indices)
         span = -(-n // self.num_workers)
-        nspans = 0
         for o in range(0, n, span):
-            self._task_q.put(
-                (slot, o,
-                 tuple(int(i) for i in batch_indices[o:o + span]), epoch)
-            )
-            nspans += 1
-        self._outstanding[slot] = nspans
+            task = (slot, o,
+                    tuple(int(i) for i in batch_indices[o:o + span]), epoch)
+            self._pending[slot][o] = task
+            self._task_q.put(task)
+        self._outstanding[slot] = len(self._pending[slot])
         return slot, n
 
     def collect(self, slot: int, out_rows: int):
         """Wait for ``slot``'s spans, copy ``out_rows`` rows out (consumer
         owns the copies), recycle the slot. Raises the worker's decode
-        error, with its traceback, if any span failed."""
+        error, with its traceback, once its retry budget is spent."""
         while self._outstanding[slot] > 0:
-            self._handle(self._next_result(), raise_errors=True)
+            self._handle(self._next_result(), mode="normal")
         imgs = np.array(self._imgs[slot, :out_rows])
         labels = np.array(self._labels[slot, :out_rows])
         self._free.append(slot)
         return imgs, labels
 
     def reset(self):
-        """Drain in-flight work from an abandoned epoch (workers always
-        finish or error their span) and mark every slot free. Errors for
-        batches nobody will consume are discarded."""
+        """Reclaim the ring after an abandoned epoch: wait out (or, on a
+        restart, simply drop) in-flight work and mark every slot free.
+        Errors for batches nobody will consume are discarded."""
         while any(self._outstanding):
-            self._handle(self._next_result(), raise_errors=False)
+            self._handle(self._next_result(requeue=False), mode="discard")
         self._free = list(range(self.slots))
+        for spans in self._pending.values():
+            spans.clear()
+        self._retries.clear()
 
-    def _next_result(self):
+    def kill_worker(self, index: int = 0) -> Optional[int]:
+        """Fault-injection/debug hook: SIGKILL one live worker process
+        (the supervisor must then restart the pool and re-enqueue its
+        span). Returns the killed pid, or None if nothing was alive.
+
+        Synchronous by design: the join guarantees the death is visible
+        to the very next liveness check, so a chaos run deterministically
+        exercises the restart path instead of racing a fast epoch."""
+        alive = [p for p in self._procs if p.is_alive()]
+        if not alive:
+            return None
+        p = alive[index % len(alive)]
+        pid = p.pid
+        p.kill()
+        p.join(timeout=5.0)
+        return pid
+
+    # -- supervision --------------------------------------------------------
+
+    def _next_result(self, requeue: bool = True):
+        """Wait for one worker ack under the watchdog: a dead worker or a
+        deadline with zero progress restarts the pool (re-enqueueing the
+        unacked spans unless ``requeue`` is off — the reset path drops
+        them instead). Liveness is checked BEFORE every wait, not only on
+        timeout: a worker that dies idle (its spans picked up by the
+        survivors) would otherwise silently shrink the pool forever."""
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            dead = [p for p in self._procs if not p.is_alive()]
+            if dead:
+                p = dead[0]
+                self._restart_pool(
+                    f"worker {p.name} (pid {p.pid}) died with exit "
+                    f"code {p.exitcode} — killed, OOM-reaped, or a "
+                    f"native crash in the decoder",
+                    requeue=requeue,
+                )
+            elif time.monotonic() > deadline:
+                self._restart_pool(
+                    f"no worker progress for {self.timeout_s:.1f}s "
+                    f"with {sum(self._outstanding)} span(s) in flight "
+                    f"— worker hang suspected",
+                    requeue=requeue,
+                )
+            else:
+                try:
+                    return self._res_q.get(timeout=min(0.2, self.timeout_s))
+                except _queue.Empty:
+                    continue
+            if not any(self._outstanding):
+                # a reset-path restart dropped all pending work; nothing
+                # will ever ack, so hand back a sentinel the _handle
+                # modes understand as "no-op"
+                return ("none",)
+            deadline = time.monotonic() + self.timeout_s
+
+    def _restart_pool(self, reason: str, requeue: bool = True):
+        """Kill + respawn the pool; re-enqueue every unacked span (safe:
+        spans are deterministic pure writes into disjoint rows)."""
+        self._consec_failures += 1
+        if self._consec_failures > self.max_restarts:
+            raise WorkerPoolBroken(
+                f"data-worker pool failed {self._consec_failures} "
+                f"consecutive times (budget {self.max_restarts}); last "
+                f"failure: {reason}"
+            )
+        self._restarts_total += 1
+        print(
+            f"WARNING: dptpu data-worker pool restart "
+            f"{self._consec_failures}/{self.max_restarts}: {reason}",
+            file=sys.stderr,
+        )
+        for p in self._procs:
+            if p.is_alive():
+                p.kill()
+        for p in self._procs:
+            p.join(timeout=2.0)
+        # salvage acks already delivered before the failure, then drop
+        # the torn queues (a SIGKILL mid-put can wedge them)
         while True:
             try:
-                return self._res_q.get(timeout=1.0)
-            except _queue.Empty:
-                for p in self._procs:
-                    if not p.is_alive():
-                        raise RuntimeError(
-                            f"data worker {p.name} (pid {p.pid}) died with "
-                            f"exit code {p.exitcode} without reporting an "
-                            f"error — likely OOM-killed or a native crash "
-                            f"in the decoder"
-                        ) from None
+                msg = self._res_q.get_nowait()
+            except Exception:
+                # Empty, or a torn message from the killed worker's
+                # feeder thread (UnpicklingError & friends) — either way
+                # the queue is done yielding salvage; the restart's span
+                # re-enqueue covers whatever was lost
+                break
+            if msg[0] == "done":
+                self._handle(msg, mode="normal")
+            # drained error acks stay pending: the restart re-enqueues
+            # them, which is exactly a retry
+        for q in (self._task_q, self._res_q):
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:
+                pass
+        self._start_workers()
+        if requeue:
+            for spans in self._pending.values():
+                for task in spans.values():
+                    self._task_q.put(task)
+        else:
+            for spans in self._pending.values():
+                spans.clear()
+            self._outstanding = [0] * self.slots
+            self._retries.clear()
 
-    def _handle(self, msg, raise_errors: bool):
-        kind, worker_id, slot = msg[0], msg[1], msg[2]
-        self._outstanding[slot] -= 1
+    def _handle(self, msg, mode: str = "normal"):
+        """Apply one worker ack. Modes: ``normal`` (collect path — retry
+        errored spans up to the budget, then raise with the worker's
+        traceback), ``discard`` (reset path — drop errored spans)."""
+        kind = msg[0]
+        if kind == "none":  # restart-with-drop sentinel from _next_result
+            return
+        worker_id, slot, offset = msg[1], msg[2], msg[3]
         if kind == "done":
-            self._worker_cache[worker_id] = (msg[3], msg[4])
-        elif kind == "error" and raise_errors:
-            raise RuntimeError(
-                f"data worker {worker_id} failed while decoding (batch "
-                f"slot {slot}); worker traceback:\n{msg[3]}"
+            self._consec_failures = 0  # the pool is making progress
+            self._outstanding[slot] -= 1
+            self._pending[slot].pop(offset, None)
+            self._retries.pop((slot, offset), None)
+            self._worker_cache[worker_id] = (msg[4], msg[5])
+            return
+        # kind == "error"
+        if mode == "discard":
+            self._outstanding[slot] -= 1
+            self._pending[slot].pop(offset, None)
+            self._retries.pop((slot, offset), None)
+            return
+        attempts = self._retries.get((slot, offset), 0)
+        task = self._pending[slot].get(offset)
+        if attempts < self.span_retries and task is not None:
+            self._retries[(slot, offset)] = attempts + 1
+            self._span_retries_total += 1
+            print(
+                f"WARNING: dptpu data worker {worker_id} errored on batch "
+                f"slot {slot} offset {offset}; retrying span "
+                f"({attempts + 1}/{self.span_retries})",
+                file=sys.stderr,
             )
+            self._task_q.put(task)
+            return
+        raise RuntimeError(
+            f"data worker {worker_id} failed while decoding (batch "
+            f"slot {slot}, offset {offset}"
+            + (f", after {attempts} retries" if attempts else "")
+            + f"); worker traceback:\n{msg[4]}"
+        )
 
     # -- telemetry ----------------------------------------------------------
 
@@ -243,6 +468,13 @@ class ShmBatchPipeline:
             "cache_hit_rate": (hits / total) if total else 0.0,
         }
 
+    def supervision_stats(self) -> dict:
+        """Watchdog counters for feed telemetry."""
+        return {
+            "pool_restarts": self._restarts_total,
+            "span_retries": self._span_retries_total,
+        }
+
     # -- lifecycle ----------------------------------------------------------
 
     def close(self):
@@ -251,15 +483,24 @@ class ShmBatchPipeline:
         self._closed = True
         for p in self._procs:
             if p.is_alive():
-                self._task_q.put(None)
+                try:
+                    self._task_q.put(None)
+                except Exception:
+                    pass
         for p in self._procs:
-            p.join(timeout=5.0)
+            p.join(timeout=1.0)
             if p.is_alive():
                 p.terminate()
-                p.join(timeout=5.0)
+                p.join(timeout=2.0)
+            if p.is_alive():  # hung in non-interruptible state: no mercy
+                p.kill()
+                p.join(timeout=2.0)
         for q in (self._task_q, self._res_q):
-            q.close()
-            q.cancel_join_thread()
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:
+                pass
         self._imgs = self._labels = None  # release buffer exports first
         for shm in (self._shm_imgs, self._shm_labels):
             try:
@@ -267,6 +508,7 @@ class ShmBatchPipeline:
                 shm.unlink()
             except FileNotFoundError:
                 pass
+        _LIVE_PIPELINES.discard(self)
 
     def __del__(self):
         try:

@@ -1,0 +1,46 @@
+"""Fail-fast environment-knob parsing, shared by every layer.
+
+The locked knob contract (SURVEY §7 / PR 1): an UNSET or empty knob means
+"use the default", but every EXPLICIT value must parse or raise an
+actionable error — a typo'd knob must never silently fall back. One
+implementation serves the trainer (``dptpu/train/fit.py``), the data
+pipeline's supervision knobs (``dptpu/data/shm.py``) and the fault
+harness (``dptpu/resilience/faults.py``); this module is imported inside
+spawned data workers, so it stays stdlib-only — never JAX.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_int(name: str, default: Optional[int] = None,
+            environ=None) -> Optional[int]:
+    """Integer env knob; unset/empty → ``default`` (pass None so callers
+    can tell an explicit 0 from absence), junk → actionable error."""
+    raw = (environ if environ is not None else os.environ).get(
+        name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (expected e.g. {name}=2)"
+        ) from None
+
+
+def env_float(name: str, default: Optional[float] = None,
+              environ=None) -> Optional[float]:
+    """Float env knob; unset/empty → ``default``, junk → actionable error."""
+    raw = (environ if environ is not None else os.environ).get(
+        name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number (expected e.g. {name}=2.5)"
+        ) from None

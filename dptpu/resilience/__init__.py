@@ -1,0 +1,41 @@
+"""Resilience layer: survive preemption, worker failure, and torn writes.
+
+Three pillars (ISSUE 2 / ROADMAP fault-tolerance):
+
+* **preemption-safe mid-epoch checkpointing** — rotated, CRC-sealed step
+  checkpoints (:mod:`dptpu.resilience.checkpoint`) whose ``(epoch,
+  step_in_epoch, data_position)`` coordinates replay the deterministic
+  ``(seed, epoch, index)`` sampler to the exact saved position, so a
+  resumed run's trajectory is bit-identical to an uninterrupted one;
+* **supervised data workers** — the shared-memory pool's watchdog /
+  restart / span-retry / degrade-to-thread machinery lives with the pool
+  in ``dptpu/data/shm.py``; its fault hooks come from here;
+* **fault injection** — :mod:`dptpu.resilience.faults`, the
+  ``DPTPU_FAULT`` chaos harness driven by ``scripts/run_faultbench.py``.
+
+This ``__init__`` is LAZY (module ``__getattr__``): spawned data workers
+import ``dptpu.resilience.faults`` for their fault hooks, and must not
+drag the checkpoint module's jax/flax imports into every decode process.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "FaultPlan": "dptpu.resilience.faults",
+    "PreemptionGuard": "dptpu.resilience.preemption",
+    "CheckpointManager": "dptpu.resilience.checkpoint",
+    "find_resumable": "dptpu.resilience.checkpoint",
+    "step_checkpoint_name": "dptpu.resilience.checkpoint",
+    "verify_checkpoint": "dptpu.resilience.checkpoint",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,209 @@
+"""Mid-epoch checkpoint rotation + corrupt-file-tolerant resume scanning.
+
+The trainer's epoch-boundary ``checkpoint.pth.tar`` (the reference's
+contract) stays untouched; this module adds rotated STEP checkpoints —
+``checkpoint-e0003-s000120.pth.tar`` = "epoch 3, 120 batches consumed" —
+written every ``--ckpt-steps`` steps and on preemption, keeping the last
+``--ckpt-keep``. Resume goes through :func:`find_resumable`, which accepts
+a file OR a directory, verifies candidates (content CRC when present,
+structural parse otherwise), and falls back past corrupt/truncated files
+to the newest verifiable one — under the deterministic ``(seed, epoch,
+index)`` data contract, resuming from an OLDER position is always safe
+(the replay reproduces the exact same trajectory, just re-earns some
+steps), whereas trusting a torn file is not.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Optional
+
+# NOTE: dptpu.train.checkpoint is imported lazily inside the functions
+# below — importing it at module scope runs dptpu.train.__init__, which
+# imports fit, which imports this package: a cycle. The names this module
+# needs (save_checkpoint, split_payload, CHECKPOINT_NAME, ...) are stable.
+
+CHECKPOINT_NAME = "checkpoint.pth.tar"  # mirrors dptpu.train.checkpoint
+STEP_CHECKPOINT_RE = re.compile(r"^checkpoint-e(\d+)-s(\d+)\.pth\.tar$")
+
+
+def step_checkpoint_name(epoch: int, step_in_epoch: int) -> str:
+    return f"checkpoint-e{epoch:04d}-s{step_in_epoch:06d}.pth.tar"
+
+
+def verify_checkpoint(path: str) -> tuple:
+    """Cheap integrity triage without building a state template; returns
+    ``(ok, reason)``.
+
+    * empty file → rejected (crashed write);
+    * dptpu file with CRC footer → CRC decides;
+    * footerless flax file (pre-resilience) → accepted iff the msgpack
+      envelope still parses to a dict (catches truncation, which also
+      removes the footer a new-format file would have had);
+    * reference torch file (zip / legacy-pickle magic) → accepted
+      (no checksum to check; ``load_checkpoint`` handles the rest).
+    """
+    from dptpu.train.checkpoint import CorruptCheckpointError, split_payload
+
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        return False, f"unreadable: {e}"
+    if not raw:
+        return False, "empty file (0 bytes)"
+    if raw[:4] == b"PK\x03\x04" or raw[:2] == b"\x80\x02":
+        return True, "torch-format (unverifiable, accepted)"
+    try:
+        payload, verified = split_payload(raw, path)
+    except CorruptCheckpointError as e:
+        return False, str(e)
+    if verified:
+        return True, "crc ok"
+    try:
+        from flax import serialization
+
+        restored = serialization.msgpack_restore(payload)
+    except Exception as e:
+        return False, f"no crc footer and msgpack parse failed: {e}"
+    if not isinstance(restored, dict):
+        return False, "no crc footer and payload is not a dict"
+    return True, "legacy footerless (structurally intact, accepted)"
+
+
+def _candidates(directory: str):
+    """Checkpoint files in ``directory``, newest-first by mtime (the save
+    order). ``model_best`` is a copy, not a resume point — excluded."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if name == CHECKPOINT_NAME or STEP_CHECKPOINT_RE.match(name):
+            p = os.path.join(directory, name)
+            try:
+                out.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+    out.sort(reverse=True)
+    return [p for _, p in out]
+
+
+def find_resumable(path: str, verbose: bool = True) -> Optional[str]:
+    """Resolve ``--resume PATH`` to the newest VERIFIABLE checkpoint.
+
+    ``path`` may name a file (used if it verifies; otherwise its siblings
+    are scanned) or a directory (scanned directly). Returns None when
+    nothing loadable exists — the caller keeps the reference's
+    warn-and-continue behavior (imagenet_ddp.py:152-153).
+    """
+    tried = []
+    if os.path.isfile(path):
+        ok, reason = verify_checkpoint(path)
+        if ok:
+            return path
+        tried.append((path, reason))
+        directory = os.path.dirname(path) or "."
+    elif os.path.isdir(path):
+        directory = path
+    else:
+        return None
+    for cand in _candidates(directory):
+        if any(cand == t for t, _ in tried):
+            continue
+        ok, reason = verify_checkpoint(cand)
+        if ok:
+            if tried and verbose:
+                skipped = ", ".join(
+                    f"'{t}' ({r})" for t, r in tried
+                )
+                print(
+                    f"=> resume fell back to '{cand}' — skipped corrupt "
+                    f"checkpoint(s): {skipped}",
+                    file=sys.stderr,
+                )
+            return cand
+        tried.append((cand, reason))
+    if tried and verbose:
+        print(
+            f"=> no verifiable checkpoint under '{directory}' — "
+            + "; ".join(f"'{t}': {r}" for t, r in tried),
+            file=sys.stderr,
+        )
+    return None
+
+
+class CheckpointManager:
+    """Rotated step-checkpoint writer (chief-only, like every other save).
+
+    ``save_step`` writes ``checkpoint-e{epoch}-s{step}.pth.tar`` through
+    the same atomic+fsync'd+CRC'd ``save_checkpoint`` path as boundary
+    saves, runs the ``ckpt_truncate`` fault hook when a plan is armed,
+    and prunes rotated files beyond ``keep`` (oldest first; the
+    epoch-boundary ``checkpoint.pth.tar``/``model_best`` are never
+    rotation victims).
+    """
+
+    def __init__(self, directory: str = ".", keep: int = 3,
+                 is_chief: bool = True, arch: str = "",
+                 batch_size: Optional[int] = None, fault_plan=None):
+        if keep < 1:
+            raise ValueError(f"ckpt keep={keep} must be >= 1")
+        self.directory = directory
+        self.keep = keep
+        self.is_chief = is_chief
+        self.arch = arch
+        self.batch_size = batch_size
+        self.fault_plan = fault_plan
+
+    def save_step(self, state, *, epoch: int, step_in_epoch: int,
+                  best_acc1: float = 0.0) -> Optional[str]:
+        from dptpu.train.checkpoint import save_checkpoint
+
+        if not self.is_chief:
+            return None
+        path = save_checkpoint(
+            state,
+            epoch=epoch,
+            arch=self.arch,
+            best_acc1=best_acc1,
+            is_best=False,
+            directory=self.directory,
+            is_chief=True,
+            filename=step_checkpoint_name(epoch, step_in_epoch),
+            step_in_epoch=step_in_epoch,
+            data_position=(
+                step_in_epoch * self.batch_size
+                if self.batch_size is not None else None
+            ),
+        )
+        if self.fault_plan is not None:
+            self.fault_plan.on_checkpoint_saved(path)
+        self._rotate()
+        return path
+
+    def _rotate(self):
+        # prune by mtime (save order), NOT by (epoch, step): after a
+        # corrupt-fallback resume an old torn higher-step file can still
+        # sit in the directory, and position-ordering would keep it while
+        # evicting the fresh valid saves — mtime matches find_resumable's
+        # newest-first scan, so rotation and resume agree on "newest"
+        files = []
+        for name in os.listdir(self.directory):
+            m = STEP_CHECKPOINT_RE.match(name)
+            if m:
+                path = os.path.join(self.directory, name)
+                try:
+                    mtime = os.path.getmtime(path)
+                except OSError:
+                    continue
+                files.append((mtime, int(m.group(1)), int(m.group(2)), name))
+        files.sort()  # oldest save first
+        for _, _, _, name in files[: max(len(files) - self.keep, 0)]:
+            try:
+                os.remove(os.path.join(self.directory, name))
+            except OSError:
+                pass

@@ -1,0 +1,190 @@
+"""Fault-injection harness: env/config-driven chaos for resilience testing.
+
+``DPTPU_FAULT`` holds a comma-separated list of fault specs; each spec is a
+kind plus ``key=value`` modifiers joined by ``@`` or ``:`` (both separators
+are accepted everywhere — ``sigterm@step=12`` and ``io_error:p=0.1`` read
+naturally):
+
+* ``sigterm@step=N`` — after the N-th optimizer step completes in this
+  process, deliver SIGTERM to ourselves. Exercises the preemption path:
+  the trainer must finish the in-flight step, save a mid-epoch
+  checkpoint, and return cleanly (exit 0).
+* ``worker_kill@step=N`` — after step N, SIGKILL one live data-worker
+  process (process-mode loader only). Exercises the pool supervisor's
+  crash-restart + span re-enqueue path.
+* ``ckpt_truncate@save=N`` — truncate the N-th checkpoint written after
+  arming (default the 1st) to half its bytes. Exercises the resume
+  scanner's fall-back-past-corrupt-file path.
+* ``io_error:p=F`` — each data-worker sample decode raises ``OSError``
+  with probability F (per-worker deterministic RNG seeded from
+  ``DPTPU_FAULT_SEED`` + worker id, so a retry of the same span draws a
+  fresh outcome — a *transient* fault). Exercises span retries.
+* ``worker_hang@index=K`` — a data worker decoding sample index K sleeps
+  effectively forever. Deterministic (every retry hangs again), so it
+  drives the watchdog all the way to pool-restart exhaustion and the
+  graceful degrade to thread mode.
+
+Worker-side kinds (``io_error``, ``worker_hang``) take effect in spawned
+decode workers, which re-parse the inherited environment — no pickling of
+the plan is needed. Trainer-side kinds fire from ``on_step``; step counts
+are 1-based counts of steps executed by THIS process (a resumed run counts
+from 1 again), which is what a chaos harness wants: "kill me N steps in".
+
+This module is imported inside data workers: stdlib only, never JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import time
+from typing import Callable, Optional
+
+from dptpu.envknob import env_int
+
+_KINDS = ("sigterm", "worker_kill", "ckpt_truncate", "io_error", "worker_hang")
+_HANG_SECONDS = 3600.0
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str
+    step: Optional[int] = None
+    save: Optional[int] = None
+    index: Optional[int] = None
+    p: float = 0.0
+    fired: bool = False
+
+
+def _parse_one(spec: str) -> _Fault:
+    parts = spec.replace("@", ":").split(":")
+    kind = parts[0].strip()
+    if kind not in _KINDS:
+        raise ValueError(
+            f"DPTPU_FAULT kind {kind!r} unknown — accepted kinds: "
+            f"{', '.join(_KINDS)} (e.g. DPTPU_FAULT=sigterm@step=12)"
+        )
+    f = _Fault(kind=kind)
+    for mod in parts[1:]:
+        if "=" not in mod:
+            raise ValueError(
+                f"DPTPU_FAULT modifier {mod!r} in {spec!r} must be "
+                f"key=value (step=N, save=N, index=K, p=F)"
+            )
+        key, val = (s.strip() for s in mod.split("=", 1))
+        try:
+            if key == "step":
+                f.step = int(val)
+            elif key == "save":
+                f.save = int(val)
+            elif key == "index":
+                f.index = int(val)
+            elif key == "p":
+                f.p = float(val)
+                if not 0.0 <= f.p <= 1.0:
+                    raise ValueError
+            else:
+                raise KeyError
+        except KeyError:
+            raise ValueError(
+                f"DPTPU_FAULT modifier key {key!r} in {spec!r} unknown "
+                f"(accepted: step, save, index, p)"
+            ) from None
+        except ValueError:
+            raise ValueError(
+                f"DPTPU_FAULT modifier {key}={val!r} in {spec!r} is not a "
+                f"valid value"
+            ) from None
+    # arm-time validation so a typo'd plan fails before training starts
+    if f.kind in ("sigterm", "worker_kill") and f.step is None:
+        raise ValueError(f"DPTPU_FAULT {spec!r} needs @step=N")
+    if f.kind == "worker_hang" and f.index is None:
+        raise ValueError(f"DPTPU_FAULT {spec!r} needs @index=K")
+    if f.kind == "io_error" and not f.p:
+        raise ValueError(f"DPTPU_FAULT {spec!r} needs :p=F with F > 0")
+    return f
+
+
+class FaultPlan:
+    """A parsed ``DPTPU_FAULT`` spec with the three injection hooks the
+    trainer and the data workers call: ``on_step`` (trainer, after each
+    optimizer step), ``on_checkpoint_saved`` (checkpoint writer), and
+    ``worker_decode_hook`` (data worker, per sample)."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self.faults = [
+            _parse_one(s) for s in spec.split(",") if s.strip()
+        ]
+        if not self.faults:
+            raise ValueError(f"DPTPU_FAULT={spec!r} parsed to no faults")
+        self._steps_done = 0
+        self._saves_done = 0
+        self._kill_worker_cb: Optional[Callable] = None
+        self._worker_rng: Optional[random.Random] = None
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["FaultPlan"]:
+        environ = environ if environ is not None else os.environ
+        spec = environ.get("DPTPU_FAULT", "").strip()
+        if not spec:
+            return None
+        return cls(spec, seed=env_int("DPTPU_FAULT_SEED", 0, environ))
+
+    def bind_worker_kill(self, cb: Callable):
+        """Wire the trainer-side ``worker_kill`` fault to a callable that
+        SIGKILLs one live data worker (e.g. DataLoader.kill_one_worker)."""
+        self._kill_worker_cb = cb
+
+    # -- trainer-side hooks -------------------------------------------------
+
+    def on_step(self):
+        """Call once after each completed optimizer step."""
+        self._steps_done += 1
+        for f in self.faults:
+            if f.fired or f.step != self._steps_done:
+                continue
+            if f.kind == "sigterm":
+                f.fired = True
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "worker_kill":
+                f.fired = True
+                if self._kill_worker_cb is not None:
+                    self._kill_worker_cb()
+
+    def on_checkpoint_saved(self, path: str) -> bool:
+        """Call after every checkpoint write; truncates the armed save in
+        place (returns True when it fired) to simulate a partial write."""
+        self._saves_done += 1
+        for f in self.faults:
+            if f.kind != "ckpt_truncate" or f.fired:
+                continue
+            if self._saves_done == (f.save or 1):
+                f.fired = True
+                size = os.path.getsize(path)
+                with open(path, "r+b") as fh:
+                    fh.truncate(max(size // 2, 1))
+                return True
+        return False
+
+    # -- worker-side hook ---------------------------------------------------
+
+    def worker_decode_hook(self, worker_id: int, index: int):
+        """Call per sample decode inside a data worker; may hang or raise
+        an injected transient ``OSError``."""
+        for f in self.faults:
+            if f.kind == "worker_hang" and index == f.index:
+                time.sleep(_HANG_SECONDS)
+            elif f.kind == "io_error":
+                if self._worker_rng is None:
+                    self._worker_rng = random.Random(
+                        (self.seed << 16) ^ (worker_id + 1)
+                    )
+                if self._worker_rng.random() < f.p:
+                    raise OSError(
+                        f"injected io_error (p={f.p}) decoding sample "
+                        f"{index} in worker {worker_id}"
+                    )

@@ -1,0 +1,56 @@
+"""Preemption guard: turn SIGTERM/SIGINT into a cooperative stop flag.
+
+Shared-cluster preemption delivers SIGTERM with a grace window; the
+reference just dies and loses everything since the last epoch-boundary
+``torch.save``. ``PreemptionGuard`` installs handlers for the duration of
+the training loop: the FIRST signal only sets ``requested`` — the loop
+finishes the in-flight step, writes a mid-epoch checkpoint, and returns
+normally (exit 0) — while a SECOND signal raises ``KeyboardInterrupt`` so
+an operator hammering Ctrl-C still gets out promptly (the trainer's
+emergency-save path catches it on the way up).
+
+Signal handlers can only be installed from the main thread; elsewhere
+(e.g. a fit() driven from a worker thread in tests) the guard degrades to
+an inert flag instead of crashing.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+
+class PreemptionGuard:
+    """Context manager; ``requested`` flips on the first SIGTERM/SIGINT."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._old = {}
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            raise KeyboardInterrupt(
+                f"second signal {signal.Signals(signum).name} during "
+                f"graceful preemption — aborting now"
+            )
+        self.requested = True
+        self.signum = signum
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._old[s] = signal.signal(s, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old = {}
+        return False
+
+    @property
+    def signal_name(self) -> str:
+        return signal.Signals(self.signum).name if self.signum else ""

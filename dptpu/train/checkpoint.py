@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import os
 import shutil
+import struct
+import zlib
 from typing import Optional
 
 import jax
@@ -36,6 +38,49 @@ from dptpu.train.state import map_momentum
 
 CHECKPOINT_NAME = "checkpoint.pth.tar"
 BEST_NAME = "model_best.pth.tar"
+
+# Content-checksum footer: ``payload || CRC_MAGIC || crc32(payload)``.
+# Appended (not prepended) so pre-footer files and the reference's torch
+# files keep loading unchanged; a truncated write loses the footer and a
+# bit-flip fails the CRC — both are detected before flax ever parses.
+CRC_MAGIC = b"DPTPUCRC"
+_FOOTER_LEN = len(CRC_MAGIC) + 4
+
+
+class EmptyCheckpointError(FileNotFoundError):
+    """A checkpoint file that exists but holds zero bytes — the signature
+    of a crash between ``open`` and the first write (or a power loss with
+    no fsync). Derives from FileNotFoundError so warn-and-continue resume
+    paths can treat 'empty' like 'absent'."""
+
+
+class CorruptCheckpointError(ValueError):
+    """Checkpoint bytes fail their content checksum or parse."""
+
+
+def seal_payload(payload: bytes) -> bytes:
+    """Append the CRC footer to serialized checkpoint bytes."""
+    return payload + CRC_MAGIC + struct.pack(
+        "<I", zlib.crc32(payload) & 0xFFFFFFFF
+    )
+
+
+def split_payload(raw: bytes, path: str = "<bytes>") -> tuple:
+    """Strip + verify the CRC footer; returns ``(payload, verified)``.
+
+    ``verified`` is False for pre-footer (legacy) files, which pass
+    through untouched; a present-but-wrong CRC raises
+    :class:`CorruptCheckpointError`.
+    """
+    if len(raw) >= _FOOTER_LEN and raw[-_FOOTER_LEN:-4] == CRC_MAGIC:
+        payload, crc = raw[:-_FOOTER_LEN], raw[-4:]
+        if struct.unpack("<I", crc)[0] != (zlib.crc32(payload) & 0xFFFFFFFF):
+            raise CorruptCheckpointError(
+                f"{path}: checkpoint content checksum mismatch — the file "
+                f"is corrupt (bit rot or a partial overwrite)"
+            )
+        return payload, True
+    return raw, False
 
 
 def save_checkpoint(
@@ -49,8 +94,16 @@ def save_checkpoint(
     is_chief: bool = True,
     training_time: Optional[float] = None,
     filename: str = CHECKPOINT_NAME,
+    step_in_epoch: int = 0,
+    data_position: Optional[int] = None,
 ) -> Optional[str]:
-    """Serialize state; copy to model_best when ``is_best``. Chief-only."""
+    """Serialize state; copy to model_best when ``is_best``. Chief-only.
+
+    ``step_in_epoch``/``data_position`` are the mid-epoch resume
+    coordinates (dptpu/resilience): batches already consumed from epoch
+    ``epoch`` and samples consumed per shard. 0 means an epoch boundary
+    (the reference's only save point, imagenet_ddp.py:216-222).
+    """
     if not is_chief:
         return None
     payload = {
@@ -66,13 +119,30 @@ def save_checkpoint(
         # (like round 4's [q|k|v]-major -> head-major move) detect and
         # migrate old files instead of silently scrambling them
         "qkv_layout": QKV_LAYOUT,
+        "step_in_epoch": int(step_in_epoch),
+        "data_position": int(
+            data_position if data_position is not None else -1
+        ),
     }
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, filename)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(payload))
+        f.write(seal_payload(serialization.to_bytes(payload)))
+        f.flush()
+        # atomic rename alone is not durable: without the fsync the
+        # kernel may rename before the data blocks land, and a power
+        # loss yields a zero-length (or half-written) "checkpoint"
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    try:  # best-effort: persist the rename itself (the dirent)
+        dirfd = os.open(directory or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    except OSError:
+        pass  # e.g. filesystems/platforms that refuse directory fds
     if is_best:
         shutil.copyfile(path, os.path.join(directory, BEST_NAME))
     return path
@@ -95,6 +165,13 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     """
     with open(path, "rb") as f:
         raw = f.read()
+    if not raw:
+        raise EmptyCheckpointError(
+            f"{path}: checkpoint file is empty (0 bytes) — a crashed or "
+            f"power-lost write; resume from an older checkpoint (the "
+            f"resilience scanner, dptpu.resilience.find_resumable, does "
+            f"this automatically)"
+        )
     # dispatch on the file's magic, not on a failed parse: a torch file is
     # a zip (PK..) or legacy pickle (protocol-2 \x80 prefix); anything
     # else goes to flax so a genuinely corrupt/mismatched flax payload
@@ -102,6 +179,7 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     # the torch path never pays for building the flax template)
     if raw[:4] == b"PK\x03\x04" or raw[:2] == b"\x80\x02":
         return _load_torch_checkpoint(path, state, arch, steps_per_epoch)
+    raw, _verified = split_payload(raw, path)
     template = {
         "epoch": 0,
         "arch": "",
@@ -112,26 +190,31 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "opt_state": jax.device_get(state.opt_state),
         "training_time": -1.0,
         "qkv_layout": "",
+        "step_in_epoch": 0,
+        "data_position": -1,
     }
+    # Optional bookkeeping fields, defaulted when absent so every older
+    # payload generation parses: pre-round-4 files lack qkv_layout (and
+    # get the ViT attention-column migration below), pre-resilience files
+    # lack the mid-epoch resume coordinates.
+    _OPTIONAL = ("qkv_layout", "step_in_epoch", "data_position")
     # structural legacy detection, single decode: restore the msgpack
     # tree once (raises its precise error on a corrupt file), pick the
     # template by the payload's own top-level keys, and validate with
     # from_state_dict (from_bytes is exactly restore + from_state_dict).
-    # A pre-round-4 payload has no qkv_layout field — parse it with the
-    # legacy template, then migrate ViT attention columns from
-    # [q|k|v]-major to head-major (dptpu/models/vit.py).
     restored = serialization.msgpack_restore(raw)
     if not isinstance(restored, dict):
-        raise ValueError(
+        raise CorruptCheckpointError(
             f"{path}: checkpoint payload is {type(restored).__name__}, "
             "not a dict — corrupt or not a dptpu checkpoint"
         )
-    if "qkv_layout" in restored:
-        payload = serialization.from_state_dict(template, restored)
-    else:
-        legacy = {k: v for k, v in template.items() if k != "qkv_layout"}
-        payload = serialization.from_state_dict(legacy, restored)
-        payload["qkv_layout"] = ""
+    present = {
+        k: v for k, v in template.items()
+        if k not in _OPTIONAL or k in restored
+    }
+    payload = serialization.from_state_dict(present, restored)
+    for k in _OPTIONAL:
+        payload.setdefault(k, template[k])
     params = payload["params"]
     opt_state = payload["opt_state"]
     ckpt_arch = payload["arch"] or arch or ""
@@ -153,6 +236,8 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "arch": payload["arch"],
         "best_acc1": float(payload["best_acc1"]),
         "training_time": float(payload["training_time"]),
+        "step_in_epoch": int(payload["step_in_epoch"]),
+        "data_position": int(payload["data_position"]),
     }
     return new_state, meta
 
@@ -261,5 +346,8 @@ def _load_torch_checkpoint(path: str, state, arch: Optional[str],
         "arch": arch,
         "best_acc1": float(ckpt.get("best_acc1", 0.0)),
         "training_time": float(ckpt.get("training_time", -1.0)),
+        # the reference only saves on epoch boundaries
+        "step_in_epoch": 0,
+        "data_position": -1,
     }
     return new_state, meta

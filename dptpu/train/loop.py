@@ -34,6 +34,12 @@ def train_one_epoch(
     print_freq: int = 10,
     verbose: bool = True,
     feed_stats: Callable = None,
+    start_step: int = 0,
+    should_stop: Callable = None,
+    on_step: Callable = None,
+    ckpt_every: int = 0,
+    ckpt_cb: Callable = None,
+    emergency_cb: Callable = None,
 ):
     """One training epoch. ``batches`` yields device-ready batch dicts.
 
@@ -42,6 +48,23 @@ def train_one_epoch(
     once at epoch end and its entries (workers_mode, cache hit rate, …)
     are merged into the stats — the input-pipeline half of the feed-rate
     telemetry, alongside the loop's own ``data_time``/``starvation``.
+
+    Resilience hooks (all optional, dptpu/resilience):
+
+    * ``start_step`` — batches of this epoch already consumed before a
+      mid-epoch resume (display offset + step accounting; the caller
+      feeds a correspondingly-skipped batch iterator);
+    * ``should_stop()`` — checked after every completed step; True means
+      a preemption signal arrived: stop cleanly NOW (the in-flight step
+      is already finished) and return ``stats["preempted"] = True`` so
+      the caller saves a mid-epoch checkpoint and exits 0;
+    * ``on_step()`` — fault-injection tick, called after each step;
+    * ``ckpt_cb(state, steps_done)`` — called every ``ckpt_every`` steps
+      with the post-step state (the ``--ckpt-steps`` writer);
+    * ``emergency_cb(state, steps_done)`` — called (best-effort, errors
+      swallowed) when the loop dies on an unexpected exception, with the
+      last CONSISTENT ``(state, position)`` pair, so even a crash between
+      epoch boundaries loses at most the in-flight step.
     """
     batch_time = AverageMeter("Time", ":6.3f")
     data_time = AverageMeter("Data", ":6.3f")
@@ -56,36 +79,60 @@ def train_one_epoch(
 
     pending = []  # (device_metrics, n) buffered until the next display
     last_lr = 0.0
+    steps_done = start_step  # batches of THIS epoch consumed so far
+    preempted = False
     end = time.time()
     i = -1
-    for i, batch in enumerate(batches):
-        data_time.update(time.time() - end)
-        n = int(np.prod(batch["labels"].shape))
-        state, metrics = train_step(state, batch)
-        pending.append((metrics, n))
-        if i % print_freq == 0:
-            # one sync per interval — but lag it: blocking on the newest
-            # (still in-flight) step would drain the dispatch queue and pay
-            # the ~100ms refill documented in PERF.md, so keep the last two
-            # steps un-fetched and in flight. The first display (i == 0)
-            # fetches everything so the epoch's opening line shows real
-            # values (the queue is cold there anyway).
-            # (capped below print_freq so short intervals still advance the
-            # display every interval instead of repeating stale values)
-            lag = 0 if i == 0 else min(2, max(print_freq - 1, 0))
-            cut = max(len(pending) - lag, 0)
-            ready, pending = pending[:cut], pending[cut:]
-            for m, nb in jax.device_get([(p[0], p[1]) for p in ready]):
-                losses.update(float(m["loss"]), nb)
-                top1.update(float(m["top1"]), nb)
-                top5.update(float(m["top5"]), nb)
-                last_lr = float(m.get("lr", last_lr))
-            batch_time.update(time.time() - end)
-            if verbose:
-                progress.display(i)
-        else:
-            batch_time.update(time.time() - end)
-        end = time.time()
+    try:
+        for i, batch in enumerate(batches):
+            data_time.update(time.time() - end)
+            n = int(np.prod(batch["labels"].shape))
+            state, metrics = train_step(state, batch)
+            steps_done += 1
+            pending.append((metrics, n))
+            if i % print_freq == 0:
+                # one sync per interval — but lag it: blocking on the newest
+                # (still in-flight) step would drain the dispatch queue and pay
+                # the ~100ms refill documented in PERF.md, so keep the last two
+                # steps un-fetched and in flight. The first display (i == 0)
+                # fetches everything so the epoch's opening line shows real
+                # values (the queue is cold there anyway).
+                # (capped below print_freq so short intervals still advance the
+                # display every interval instead of repeating stale values)
+                lag = 0 if i == 0 else min(2, max(print_freq - 1, 0))
+                cut = max(len(pending) - lag, 0)
+                ready, pending = pending[:cut], pending[cut:]
+                for m, nb in jax.device_get([(p[0], p[1]) for p in ready]):
+                    losses.update(float(m["loss"]), nb)
+                    top1.update(float(m["top1"]), nb)
+                    top5.update(float(m["top5"]), nb)
+                    last_lr = float(m.get("lr", last_lr))
+                batch_time.update(time.time() - end)
+                if verbose:
+                    progress.display(i + start_step)
+            else:
+                batch_time.update(time.time() - end)
+            if ckpt_every and ckpt_cb is not None \
+                    and steps_done % ckpt_every == 0:
+                ckpt_cb(state, steps_done)
+            if on_step is not None:
+                on_step()
+            if should_stop is not None and should_stop():
+                preempted = True
+                break
+            # re-stamp AFTER the hooks: a checkpoint save (gather +
+            # device_get + fsync) must not be billed to the next step's
+            # data_time / starvation feed telemetry
+            end = time.time()
+    except BaseException:
+        if emergency_cb is not None:
+            # the last fully-applied step is (state, steps_done) — a
+            # consistent resume point even when the exception hit mid-step
+            try:
+                emergency_cb(state, steps_done)
+            except Exception:
+                pass
+        raise
     for m, nb in jax.device_get(pending):
         losses.update(float(m["loss"]), nb)
         top1.update(float(m["top1"]), nb)
@@ -104,6 +151,8 @@ def train_one_epoch(
         # its Data meter, imagenet_ddp_apex.py:304-351)
         "starvation": data_time.sum / max(batch_time.sum, 1e-9),
         "num_batches": i + 1,
+        "steps_done": steps_done,
+        "preempted": preempted,
     }
     if feed_stats is not None:
         for k, v in feed_stats().items():

@@ -1,0 +1,236 @@
+#!/usr/bin/env python3
+"""FAULTBENCH: chaos-run the resilience layer and prove bit-identical
+recovery — the robustness counterpart of BENCH/HOSTBENCH/FEEDBENCH.
+
+Four scenarios, each injected through the production ``DPTPU_FAULT``
+harness (dptpu/resilience/faults.py) against the FULL ``fit()`` path on
+synthetic data, each compared against one uninterrupted baseline run:
+
+* ``sigterm``       — preempt mid-epoch; resume must replay the sampler
+                      to the saved step and match the baseline bit for
+                      bit (params max |Δ| == 0, val-loss trajectory == 0);
+* ``ckpt_truncate`` — preempt AND tear the newest checkpoint; resume
+                      must fall back to the older verifiable rotation
+                      member and still match bit for bit;
+* ``worker_kill``   — SIGKILL a decode worker mid-run (process-mode
+                      loader); the pool supervisor restarts it and the
+                      run completes in one piece, bit-identical;
+* ``io_error``      — p=0.1 transient decode I/O errors; span retries
+                      absorb them, bit-identical.
+
+Writes ``FAULTBENCH.json`` at the repo root: faults injected, recoveries
+(pool restarts / span retries / resume fallbacks), and the resume
+trajectory's ``max |Δloss|`` — which this harness requires to be 0.0.
+Exit code is non-zero if any scenario loses bit-identity, so the bench
+doubles as a CI gate.
+
+Usage: python scripts/run_faultbench.py [--images 96] [--batch 16]
+                                        [--epochs 2] [--arch resnet18]
+                                        [--image-size 32] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU by default: the chaos contract (determinism under preemption) is
+# platform-independent; set JAX_PLATFORMS to chaos-run a real chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dptpu.config import Config  # noqa: E402
+from dptpu.resilience import find_resumable  # noqa: E402
+from dptpu.train import fit  # noqa: E402
+
+_ENV_KNOBS = ("DPTPU_FAULT", "DPTPU_FAULT_SEED", "DPTPU_WORKERS_MODE",
+              "DPTPU_SPAN_RETRIES", "DPTPU_WORKER_TIMEOUT_S",
+              "DPTPU_POOL_RESTARTS")
+
+
+def run_fit(cfg, image_size, workdir, env=None):
+    """One fit() in its own checkpoint dir with scoped env knobs."""
+    saved = {k: os.environ.pop(k, None) for k in _ENV_KNOBS}
+    cwd = os.getcwd()
+    os.makedirs(workdir, exist_ok=True)
+    os.chdir(workdir)
+    try:
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        return fit(cfg, image_size=image_size, verbose=False)
+    finally:
+        os.chdir(cwd)
+        for k in _ENV_KNOBS:
+            os.environ.pop(k, None)
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def params_max_delta(state_a, state_b):
+    la = jax.tree_util.tree_leaves(jax.device_get(state_a.params))
+    lb = jax.tree_util.tree_leaves(jax.device_get(state_b.params))
+    return max(
+        float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+        for x, y in zip(la, lb)
+    )
+
+
+def trajectory_delta(base_hist, hist):
+    """max |Δval_loss| over epochs both runs validated (val is computed
+    from the end-of-epoch state, so it is comparable even for the epoch
+    that was resumed mid-way)."""
+    deltas = [
+        abs(hb["val_loss"] - hr["val_loss"])
+        for hb, hr in zip(base_hist, hist)
+    ]
+    return max(deltas) if deltas else float("nan")
+
+
+def recoveries(result):
+    last = result["history"][-1] if result["history"] else {}
+    return {
+        "pool_restarts": int(last.get("train_pool_restarts", 0)),
+        "span_retries": int(last.get("train_span_retries", 0)),
+        "degraded": bool(last.get("train_degraded", False)),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=96)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "FAULTBENCH.json"))
+    args = ap.parse_args()
+
+    cfg = Config(
+        data=f"synthetic:{args.images}",
+        arch=args.arch,
+        epochs=args.epochs,
+        batch_size=args.batch,
+        lr=0.02,
+        workers=2,
+        print_freq=1000,
+        seed=1,
+    )
+    steps_per_epoch = args.images // args.batch
+    kill_step = max(steps_per_epoch // 2, 1)
+    root = tempfile.mkdtemp(prefix="faultbench-")
+
+    print(f"faultbench: {args.arch}@{args.image_size}px, "
+          f"{steps_per_epoch} steps/epoch x {args.epochs} epochs, "
+          f"platform={jax.devices()[0].platform}")
+    base = run_fit(cfg, args.image_size, os.path.join(root, "baseline"))
+    scenarios = []
+
+    # 1. sigterm: preempt mid-epoch 0, resume, compare
+    d = os.path.join(root, "sigterm")
+    r1 = run_fit(cfg, args.image_size, d,
+                 env={"DPTPU_FAULT": f"sigterm@step={kill_step}"})
+    resumed_from = find_resumable(d, verbose=False)
+    r2 = run_fit(cfg.replace(resume="."), args.image_size, d)
+    scenarios.append({
+        "name": "sigterm",
+        "fault": f"sigterm@step={kill_step}",
+        "preempted": bool(r1["preempted"]),
+        "resumed_from": os.path.basename(resumed_from or ""),
+        "recoveries": recoveries(r2),
+        "params_max_delta": params_max_delta(base["state"], r2["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r2["history"]),
+    })
+
+    # 2. ckpt_truncate: preempt, tear the NEWEST save, resume must fall
+    # back to an older rotation member and still match bit for bit
+    d = os.path.join(root, "ckpt_truncate")
+    n_saves = kill_step + 2  # steps 1..kill_step+1, then the preempt save
+    r1 = run_fit(
+        cfg.replace(ckpt_steps=1, ckpt_keep=3), args.image_size, d,
+        env={"DPTPU_FAULT":
+             f"ckpt_truncate@save={n_saves},sigterm@step={kill_step + 1}"},
+    )
+    resumed_from = find_resumable(d, verbose=False)
+    r2 = run_fit(cfg.replace(resume="."), args.image_size, d)
+    scenarios.append({
+        "name": "ckpt_truncate",
+        "fault": f"ckpt_truncate@save={n_saves},"
+                 f"sigterm@step={kill_step + 1}",
+        "preempted": bool(r1["preempted"]),
+        # the torn newest save was skipped: resumed one step earlier
+        "resumed_from": os.path.basename(resumed_from or ""),
+        "fell_back": bool(
+            resumed_from
+            and f"s{kill_step + 1:06d}" not in resumed_from
+        ),
+        "recoveries": recoveries(r2),
+        "params_max_delta": params_max_delta(base["state"], r2["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r2["history"]),
+    })
+
+    # 3. worker_kill: SIGKILL one decode worker; supervisor restarts the
+    # pool and the run completes uninterrupted
+    d = os.path.join(root, "worker_kill")
+    r = run_fit(cfg, args.image_size, d,
+                env={"DPTPU_FAULT": f"worker_kill@step={kill_step}",
+                     "DPTPU_WORKERS_MODE": "process"})
+    scenarios.append({
+        "name": "worker_kill",
+        "fault": f"worker_kill@step={kill_step}",
+        "preempted": bool(r["preempted"]),
+        "recoveries": recoveries(r),
+        "params_max_delta": params_max_delta(base["state"], r["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r["history"]),
+    })
+
+    # 4. io_error: transient decode failures absorbed by span retries
+    d = os.path.join(root, "io_error")
+    r = run_fit(cfg, args.image_size, d,
+                env={"DPTPU_FAULT": "io_error:p=0.1",
+                     "DPTPU_FAULT_SEED": "1",
+                     "DPTPU_WORKERS_MODE": "process",
+                     "DPTPU_SPAN_RETRIES": "20"})
+    scenarios.append({
+        "name": "io_error",
+        "fault": "io_error:p=0.1",
+        "preempted": bool(r["preempted"]),
+        "recoveries": recoveries(r),
+        "params_max_delta": params_max_delta(base["state"], r["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r["history"]),
+    })
+
+    for s in scenarios:
+        s["bit_identical"] = (
+            s["params_max_delta"] == 0.0 and s["max_abs_dloss"] == 0.0
+        )
+    out = {
+        "bench": "faultbench",
+        "platform": jax.devices()[0].platform,
+        "config": {
+            "arch": args.arch, "image_size": args.image_size,
+            "images": args.images, "batch": args.batch,
+            "epochs": args.epochs, "steps_per_epoch": steps_per_epoch,
+            "seed": cfg.seed,
+        },
+        "baseline_final_val_loss": base["history"][-1]["val_loss"],
+        "scenarios": scenarios,
+        "all_bit_identical": all(s["bit_identical"] for s in scenarios),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out, indent=2))
+    print(f"wrote {args.out}")
+    return 0 if out["all_bit_identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

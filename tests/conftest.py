@@ -25,14 +25,19 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
-# Two-tier suite: `-m fast` is the quick all-unit check (~1 min on one
-# CPU, no model compiles); everything else is the compile-heavy `slow`
-# tier. Modules are the marking unit — a whole file is fast only if none
-# of its tests build/compile a zoo model or run fit().
+# Two-tier suite: `-m fast` is the quick all-unit check (~1-2 min on one
+# CPU, at most one tiny-model compile); everything else is the
+# compile-heavy `slow` tier. Modules are the marking unit — a whole file
+# is fast only if none of its tests build/compile a zoo model or run
+# fit(). Deliberate exception: test_fault_resume (ONE resnet18@32 compile,
+# reused by every run in the module) — the resilience acceptance bar
+# "SIGTERM'd run resumes bit-identically" must hold in tier 1, and it can
+# only be asserted through fit().
 _FAST_MODULES = {
     "test_bench_logic", "test_config", "test_schedules", "test_metrics",
     "test_meters", "test_data", "test_tensorboard", "test_native",
     "test_cache", "test_shm_loader", "test_feed_knobs", "test_tv_template",
+    "test_resilience", "test_shm_supervision", "test_fault_resume",
 }
 
 

@@ -1,0 +1,240 @@
+"""Resilience primitives: fault specs, checkpoint integrity, rotation,
+corrupt-file fallback, preemption guard.
+
+The contracts under test (dptpu/resilience + train/checkpoint.py):
+
+* checkpoints carry a CRC content footer; a flipped byte or a truncated
+  tail is DETECTED, never silently loaded;
+* an empty checkpoint file raises a FileNotFoundError-derived error
+  (warn-and-continue resume treats it like absence);
+* rotated step checkpoints keep exactly ``keep`` files and resume
+  falls back PAST corrupt files to the newest verifiable one;
+* ``DPTPU_FAULT`` specs parse strictly (typos fail before training);
+* the preemption guard converts the first SIGTERM into a flag, not a
+  crash.
+"""
+
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dptpu.resilience import (
+    CheckpointManager,
+    FaultPlan,
+    PreemptionGuard,
+    find_resumable,
+    step_checkpoint_name,
+    verify_checkpoint,
+)
+from dptpu.train.checkpoint import (
+    CorruptCheckpointError,
+    EmptyCheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from dptpu.train.state import TrainState, make_optimizer
+
+
+def tiny_state(value: float = 1.0) -> TrainState:
+    """A real TrainState over a toy param tree — no model, no compile."""
+    params = {"dense": {"kernel": np.full((4, 3), value, np.float32),
+                        "bias": np.zeros((3,), np.float32)}}
+    tx = make_optimizer()
+    return TrainState(
+        step=jnp.asarray(0, jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        apply_fn=lambda *a, **k: None,
+        tx=tx,
+    )
+
+
+# -- fault spec parsing ------------------------------------------------------
+
+def test_fault_spec_parses_all_kinds():
+    p = FaultPlan("sigterm@step=12,worker_kill@step=7,ckpt_truncate@save=2,"
+                  "io_error:p=0.1,worker_hang@index=4")
+    kinds = [f.kind for f in p.faults]
+    assert kinds == ["sigterm", "worker_kill", "ckpt_truncate", "io_error",
+                     "worker_hang"]
+    assert p.faults[0].step == 12
+    assert p.faults[2].save == 2
+    assert p.faults[3].p == pytest.approx(0.1)
+    assert p.faults[4].index == 4
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@step=1",       # unknown kind
+    "io_error:p=nope",      # non-numeric probability
+    "io_error:p=1.5",       # probability out of range
+    "sigterm",              # missing required @step
+    "worker_hang",          # missing required @index
+    "sigterm@tick=3",       # unknown modifier key
+])
+def test_fault_spec_rejects_typos(bad):
+    with pytest.raises(ValueError):
+        FaultPlan(bad)
+
+
+def test_fault_plan_from_env(monkeypatch):
+    monkeypatch.delenv("DPTPU_FAULT", raising=False)
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=5")
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.faults[0].step == 5
+
+
+def test_ckpt_truncate_fault_fires_on_armed_save(tmp_path):
+    plan = FaultPlan("ckpt_truncate@save=2")
+    manager = CheckpointManager(directory=str(tmp_path), keep=5,
+                                arch="toy", fault_plan=plan)
+    p1 = manager.save_step(tiny_state(), epoch=0, step_in_epoch=1)
+    p2 = manager.save_step(tiny_state(), epoch=0, step_in_epoch=2)
+    ok1, _ = verify_checkpoint(p1)
+    ok2, reason2 = verify_checkpoint(p2)
+    assert ok1
+    assert not ok2, reason2  # the armed (2nd) save was torn in place
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+def test_checkpoint_roundtrip_carries_resume_coordinates(tmp_path):
+    state = tiny_state(2.5)
+    path = save_checkpoint(
+        state, epoch=3, arch="toy", best_acc1=12.5, is_best=False,
+        directory=str(tmp_path), step_in_epoch=17, data_position=17 * 24,
+    )
+    ok, reason = verify_checkpoint(path)
+    assert ok, reason
+    new, meta = load_checkpoint(path, tiny_state(0.0))
+    assert meta["epoch"] == 3
+    assert meta["step_in_epoch"] == 17
+    assert meta["data_position"] == 17 * 24
+    np.testing.assert_array_equal(
+        new.params["dense"]["kernel"], state.params["dense"]["kernel"]
+    )
+
+
+def test_bitflip_fails_checksum(tmp_path):
+    path = save_checkpoint(tiny_state(), epoch=1, arch="toy", best_acc1=0.0,
+                           is_best=False, directory=str(tmp_path))
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "checksum" in reason
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        load_checkpoint(path, tiny_state())
+
+
+def test_truncation_detected_even_without_footer(tmp_path):
+    """Truncation removes the CRC footer too — the scanner must not
+    mistake the stump for a healthy legacy (footerless) file."""
+    path = save_checkpoint(tiny_state(), epoch=1, arch="toy", best_acc1=0.0,
+                           is_best=False, directory=str(tmp_path))
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    ok, reason = verify_checkpoint(path)
+    assert not ok
+
+
+def test_empty_checkpoint_raises_filenotfound_subclass(tmp_path):
+    path = str(tmp_path / "checkpoint.pth.tar")
+    open(path, "wb").close()
+    with pytest.raises(FileNotFoundError, match="empty"):
+        load_checkpoint(path, tiny_state())
+    with pytest.raises(EmptyCheckpointError):
+        load_checkpoint(path, tiny_state())
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "empty" in reason
+
+
+def test_legacy_footerless_checkpoint_still_loads(tmp_path):
+    """A pre-resilience file (no CRC footer, no resume coordinates) loads
+    with defaulted coordinates — old runs keep resuming."""
+    import jax
+    from flax import serialization
+
+    state = tiny_state(1.5)
+    legacy_payload = {
+        "epoch": 2,
+        "arch": "toy",
+        "best_acc1": 5.0,
+        "step": jax.device_get(state.step),
+        "params": jax.device_get(state.params),
+        "batch_stats": {},
+        "opt_state": jax.device_get(state.opt_state),
+        "training_time": -1.0,
+        "qkv_layout": "",
+    }
+    path = str(tmp_path / "checkpoint.pth.tar")
+    open(path, "wb").write(serialization.to_bytes(legacy_payload))
+    ok, reason = verify_checkpoint(path)
+    assert ok and "legacy" in reason
+    new, meta = load_checkpoint(path, tiny_state())
+    assert meta["epoch"] == 2
+    assert meta["step_in_epoch"] == 0  # defaulted: boundary semantics
+    np.testing.assert_array_equal(
+        new.params["dense"]["kernel"], state.params["dense"]["kernel"]
+    )
+
+
+# -- rotation + fallback -----------------------------------------------------
+
+def test_rotation_keeps_last_k(tmp_path):
+    manager = CheckpointManager(directory=str(tmp_path), keep=2, arch="toy")
+    for step in range(1, 5):
+        manager.save_step(tiny_state(float(step)), epoch=0,
+                          step_in_epoch=step)
+    names = sorted(f for f in os.listdir(tmp_path) if "checkpoint-e" in f)
+    assert names == [step_checkpoint_name(0, 3), step_checkpoint_name(0, 4)]
+
+
+def test_find_resumable_falls_back_past_corrupt(tmp_path):
+    manager = CheckpointManager(directory=str(tmp_path), keep=3, arch="toy")
+    paths = [
+        manager.save_step(tiny_state(float(s)), epoch=0, step_in_epoch=s)
+        for s in (1, 2, 3)
+    ]
+    assert find_resumable(str(tmp_path)) == paths[-1]
+    with open(paths[-1], "r+b") as f:  # tear the newest
+        f.truncate(os.path.getsize(paths[-1]) // 2)
+    assert find_resumable(str(tmp_path)) == paths[-2]
+    # an explicitly-named corrupt FILE also falls back to its siblings
+    assert find_resumable(paths[-1]) == paths[-2]
+    # resume coordinates of the survivor point at step 2
+    _, meta = load_checkpoint(find_resumable(str(tmp_path)), tiny_state())
+    assert meta["step_in_epoch"] == 2
+
+
+def test_find_resumable_missing_paths(tmp_path):
+    assert find_resumable(str(tmp_path / "nope.pth.tar")) is None
+    assert find_resumable(str(tmp_path)) is None  # empty dir
+
+
+# -- preemption guard --------------------------------------------------------
+
+def test_preemption_guard_catches_sigterm_and_restores_handler():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(10000):  # let the Python-level handler run
+            if guard.requested:
+                break
+        assert guard.requested
+        assert guard.signal_name == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_guard_second_signal_aborts():
+    with PreemptionGuard() as guard:
+        guard._handler(signal.SIGTERM, None)
+        assert guard.requested
+        with pytest.raises(KeyboardInterrupt):
+            guard._handler(signal.SIGTERM, None)

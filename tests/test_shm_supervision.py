@@ -1,0 +1,151 @@
+"""Supervised data-worker pool: crash restart, hang watchdog, transient
+I/O retries, graceful degradation, atexit segment cleanup.
+
+The contract under test (dptpu/data/shm.py + loader.py): a process-mode
+loader must deliver the SAME bit-identical batches as thread mode even
+while its workers are being killed, hung, or fed injected I/O errors —
+failure costs restarts/retries (counted in ``feed_stats``), never wrong
+pixels and never a wedged job. When the pool exhausts its restart budget
+it degrades to thread mode instead of raising out of a multi-hour run.
+
+Worker-side faults come from the ``DPTPU_FAULT`` env (inherited across
+spawn), so nothing fault-related needs to cross the dataset pickle.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dptpu.data import DataLoader, SyntheticDataset
+
+
+def _batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x["images"], y["images"])
+        np.testing.assert_array_equal(x["labels"], y["labels"])
+
+
+class CrashAtFive:
+    """Deterministic decode-error fixture — module level so spawn can
+    pickle it (same pattern as tests/test_shm_loader.py)."""
+
+    def __len__(self):
+        return 12
+
+    def get(self, index, rng=None):
+        if index == 5:
+            raise ValueError("decode exploded on sample 5")
+        return np.full((8, 8, 3), index, np.uint8), index
+
+    def get_into(self, index, rng, out):
+        img, lab = self.get(index, rng)
+        np.copyto(out, img)
+        return lab
+
+    def __getitem__(self, index):
+        return self.get(index)
+
+
+@pytest.fixture()
+def reference_batches():
+    ds = SyntheticDataset(32, 8, 10)
+    th = DataLoader(ds, 4, num_workers=2, seed=3)
+    try:
+        yield ds, list(th.epoch(0))
+    finally:
+        th.close()
+
+
+def test_worker_crash_restarts_and_batches_stay_bit_identical(
+        reference_batches):
+    ds, ref = reference_batches
+    pr = DataLoader(ds, 4, num_workers=2, seed=3, workers_mode="process")
+    try:
+        it = pr.epoch(0)
+        got = [next(it)]
+        assert pr.kill_one_worker() is not None  # SIGKILL, mid-epoch
+        got += list(it)
+        _batches_equal(ref, got)
+        fs = pr.feed_stats()
+        assert fs["pool_restarts"] >= 1
+        assert "degraded" not in fs  # recovered, did NOT give up
+        assert pr.workers_mode == "process"
+    finally:
+        pr.close()
+
+
+def test_worker_hang_exhausts_restarts_then_degrades_to_thread(
+        reference_batches, monkeypatch, capsys):
+    ds, ref = reference_batches
+    # index 3 hangs DETERMINISTICALLY (every restart hangs again), so the
+    # watchdog burns its whole restart budget and must then degrade
+    monkeypatch.setenv("DPTPU_FAULT", "worker_hang@index=3")
+    monkeypatch.setenv("DPTPU_WORKER_TIMEOUT_S", "1")
+    monkeypatch.setenv("DPTPU_POOL_RESTARTS", "1")
+    pr = DataLoader(ds, 4, num_workers=2, seed=3, workers_mode="process")
+    try:
+        got = list(pr.epoch(0))
+        _batches_equal(ref, got)  # thread fallback re-decoded everything
+        assert pr.workers_mode == "thread"
+        fs = pr.feed_stats()
+        assert fs["degraded"] is True
+        assert fs["pool_restarts"] >= 1
+        err = capsys.readouterr().err
+        assert "degrading to thread mode" in err
+    finally:
+        pr.close()
+
+
+def test_transient_io_errors_are_retried_not_fatal(reference_batches,
+                                                   monkeypatch):
+    ds, ref = reference_batches
+    monkeypatch.setenv("DPTPU_FAULT", "io_error:p=0.3")
+    monkeypatch.setenv("DPTPU_FAULT_SEED", "1")
+    monkeypatch.setenv("DPTPU_SPAN_RETRIES", "25")
+    pr = DataLoader(ds, 4, num_workers=2, seed=3, workers_mode="process")
+    try:
+        got = list(pr.epoch(0))
+        _batches_equal(ref, got)
+        fs = pr.feed_stats()
+        assert fs["span_retries"] >= 1  # p=0.3 over 32 decodes must trip
+        assert pr.workers_mode == "process"
+    finally:
+        pr.close()
+
+
+def test_deterministic_decode_error_still_raises_with_traceback(
+        monkeypatch):
+    """A REAL application error (same sample fails every attempt) must
+    surface with the worker traceback once retries are spent — retries
+    cover transience, they must not bury bugs."""
+    monkeypatch.setenv("DPTPU_SPAN_RETRIES", "1")
+    loader = DataLoader(CrashAtFive(), 4, num_workers=2, seed=0,
+                        workers_mode="process")
+    try:
+        with pytest.raises(RuntimeError,
+                           match="decode exploded on sample 5"):
+            list(loader.epoch(0))
+    finally:
+        loader.close()
+
+
+def test_atexit_cleanup_unlinks_abandoned_segments():
+    import dptpu.data.shm as shm
+
+    ds = SyntheticDataset(16, 8, 10)
+    pr = DataLoader(ds, 4, num_workers=1, seed=0, workers_mode="process")
+    it = pr.epoch(0)
+    next(it)  # forces pipeline + segment creation
+    pipe = pr._pipeline
+    seg_paths = [
+        "/dev/shm/" + pipe._shm_imgs.name.lstrip("/"),
+        "/dev/shm/" + pipe._shm_labels.name.lstrip("/"),
+    ]
+    if not all(os.path.exists(p) for p in seg_paths):
+        pytest.skip("/dev/shm not exposed as a filesystem here")
+    # parent "forgets" to close(); the registered atexit hook must unlink
+    shm._atexit_close_all()
+    assert not any(os.path.exists(p) for p in seg_paths)
+    pr.close()  # double-close stays a no-op
