@@ -12,6 +12,7 @@ from dptpu.data.cache import DecodeCache
 from dptpu.data.dataset import ImageFolderDataset, SyntheticDataset
 from dptpu.data.loader import DataLoader, DevicePrefetcher
 from dptpu.data.sampler import ShardedSampler
+from dptpu.data.shm_cache import ShmDecodeCache
 from dptpu.data.transforms import (
     center_crop,
     random_horizontal_flip,
@@ -27,6 +28,7 @@ __all__ = [
     "DevicePrefetcher",
     "ImageFolderDataset",
     "ShardedSampler",
+    "ShmDecodeCache",
     "SyntheticDataset",
     "center_crop",
     "random_horizontal_flip",

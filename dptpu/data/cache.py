@@ -39,7 +39,18 @@ import numpy as np
 
 
 class DecodeCache:
-    """LRU byte-budgeted map of hashable keys → decoded uint8 arrays."""
+    """LRU byte-budgeted map of hashable keys → decoded uint8 arrays.
+
+    This is the SHARDED scope (``DPTPU_CACHE_SCOPE=sharded``): in-process
+    and private, so a worker-process pool divides the budget N ways and
+    each worker reaches only its own shard. The POOLED alternative — one
+    cross-process /dev/shm slab every worker shares, surviving pool
+    restarts — is :class:`dptpu.data.shm_cache.ShmDecodeCache`; both
+    serve the same bytes for the same key, so the scopes are
+    bit-interchangeable.
+    """
+
+    scope = "sharded"
 
     def __init__(self, budget_bytes: int):
         if budget_bytes <= 0:
@@ -69,6 +80,16 @@ class DecodeCache:
             self._entries.move_to_end(key)
             self.hits += 1
             return arr
+
+    def with_entry(self, key, fn):
+        """Uniform hit-path API with :class:`ShmDecodeCache.with_entry`:
+        ``(True, fn(cached))`` on a hit, ``(False, None)`` on a miss.
+        In-process the cached buffer is already zero-copy (read-only,
+        GC-protected), so no lock needs to be held across ``fn``."""
+        arr = self.get(key)
+        if arr is None:
+            return False, None
+        return True, fn(arr)
 
     def put(self, key, arr: np.ndarray) -> bool:
         """Insert ``arr`` under ``key``, evicting LRU entries to fit the

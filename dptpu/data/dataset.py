@@ -41,26 +41,46 @@ def _copy_checked(out: np.ndarray, img, index: int):
 class ImageFolderDataset:
     """root/<class_name>/<image> layout, torchvision class-index semantics.
 
-    ``cache_bytes > 0`` attaches a :class:`dptpu.data.cache.DecodeCache`:
-    decoded full-resolution pixels are kept (LRU, byte-budgeted) and
-    epoch 1+ re-applies only the per-epoch crop/resize/flip — a cache hit
-    skips JPEG Huffman decode entirely. Hits and misses produce identical
-    pixels for identical augmentation RNG (both resample the same decoded
-    buffer), so cache warmth never changes what a seeded run sees. Note
-    the cached native path decodes at FULL resolution on a miss (the
-    buffer must serve every future crop), whereas the uncached path may
-    use libjpeg's crop-dependent scaled decode — pixels between
-    cache-on and cache-off therefore match bit-for-bit only when the
-    scale picker stays at 8/8 (always true when no crop axis reaches
+    ``cache_bytes > 0`` attaches a decoded-pixel cache: full-resolution
+    pixels are kept (byte-budgeted, oldest-evicted) and epoch 1+
+    re-applies only the per-epoch crop/resize/flip — a cache hit skips
+    JPEG Huffman decode entirely. ``cache_scope`` picks the
+    implementation:
+
+    * ``"sharded"`` (default) — in-process
+      :class:`dptpu.data.cache.DecodeCache`; a process-mode worker pool
+      splits the budget N ways and each worker warms its own shard;
+    * ``"pooled"`` — cross-process
+      :class:`dptpu.data.shm_cache.ShmDecodeCache`: ONE /dev/shm slab of
+      the full budget shared by every worker (and surviving pool
+      restarts warm).
+
+    Hits and misses produce identical pixels for identical augmentation
+    RNG (both resample the same decoded buffer) under EITHER scope, so
+    cache warmth never changes what a seeded run sees. Note the cached
+    native path decodes at FULL resolution on a miss (the buffer must
+    serve every future crop), whereas the uncached path may use
+    libjpeg's crop-dependent scaled decode — pixels between cache-on and
+    cache-off therefore match bit-for-bit only when the scale picker
+    stays at 8/8 (always true when no crop axis reaches
     ``out_size*8/7``); for larger images the cached path resamples from
     strictly higher-resolution source pixels.
     """
 
     def __init__(self, root: str, transform: Optional[Callable] = None,
-                 cache_bytes: int = 0):
+                 cache_bytes: int = 0, cache_scope: str = "sharded"):
         self.root = root
         self.transform = transform
-        if cache_bytes:
+        if cache_scope not in ("sharded", "pooled"):
+            raise ValueError(
+                f"cache_scope={cache_scope!r} must be 'sharded' or "
+                f"'pooled'"
+            )
+        if cache_bytes and cache_scope == "pooled":
+            from dptpu.data.shm_cache import ShmDecodeCache
+
+            self.decode_cache = ShmDecodeCache(cache_bytes)
+        elif cache_bytes:
             from dptpu.data.cache import DecodeCache
 
             self.decode_cache = DecodeCache(cache_bytes)
@@ -104,22 +124,38 @@ class ImageFolderDataset:
         if not native_image.available():
             return None
         if self.decode_cache is not None:
-            full = self.decode_cache.get(("native", path))
-            if full is None:
-                with open(path, "rb") as f:
-                    data = f.read()
-                dims = native_image.jpeg_dims(data)
-                if dims is None:
-                    return None
-                full = np.empty((dims[1], dims[0], 3), np.uint8)
-                if not native_image.decode_into_cache(data, full):
-                    return None
-                self.decode_cache.put(("native", path), full)
-            h, w = full.shape[:2]
-            box, flip = self.transform.sample(w, h, rng)
-            return native_image.crop_resize(
-                full, box, self.transform.size, flip, out=out
-            )
+            rng_state = rng.bit_generator.state
+
+            def _resample(full):
+                # identical for a hit (cached view, in place — zero-copy
+                # even out of the pooled /dev/shm slab) and a miss (the
+                # freshly decoded buffer): same pixels, same rng draw.
+                # IDEMPOTENT by contract: the pooled cache's lock-free
+                # hit path may run this on a torn view and then retry or
+                # fall back to the miss path, so the rng state consumed
+                # by sample() is restored on every entry — the crop that
+                # finally lands is always the (seed, epoch, index) one.
+                rng.bit_generator.state = rng_state
+                h, w = full.shape[:2]
+                box, flip = self.transform.sample(w, h, rng)
+                return native_image.crop_resize(
+                    full, box, self.transform.size, flip, out=out
+                )
+
+            hit, res = self.decode_cache.with_entry(("native", path),
+                                                    _resample)
+            if hit:
+                return res
+            with open(path, "rb") as f:
+                data = f.read()
+            dims = native_image.jpeg_dims(data)
+            if dims is None:
+                return None
+            full = np.empty((dims[1], dims[0], 3), np.uint8)
+            if not native_image.decode_into_cache(data, full):
+                return None
+            self.decode_cache.put(("native", path), full)
+            return _resample(full)
         with open(path, "rb") as f:
             data = f.read()
         dims = native_image.jpeg_dims(data)

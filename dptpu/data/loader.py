@@ -33,6 +33,16 @@ imagenet_ddp_apex.py:26-39,304-351), rebuilt for the TPU host model:
   H2D copy of batch N+1 rides under the compute of batch N — the CUDA
   side-stream trick (imagenet_ddp_apex.py:310,329-340) without streams, and
   normalization already lives inside the compiled step.
+* ZERO-COPY LEASED FEED (process mode, ``leased=True`` /
+  ``DPTPU_LEASE``): batches are numpy VIEWS into the shared-memory ring
+  plus a ``"_lease"`` token; ``DevicePrefetcher`` releases the lease
+  after the device transfer of that batch completes, and the ring
+  recycles only released slots — the parent's per-batch copy-out is
+  gone (``feed_stats``: ``bytes_copied_per_batch = 0``). Consumers that
+  RETAIN batches (``list(loader.epoch(0))``) must keep the default
+  ``leased=False`` copy path: a leased batch's bytes are only stable
+  until the iterator advances past it (the after-yield backstop then
+  reclaims the slot).
 """
 
 from __future__ import annotations
@@ -63,7 +73,11 @@ class DataLoader:
                  sampler: Optional[ShardedSampler] = None,
                  num_workers: int = 4, drop_last: bool = False,
                  pad_final: bool = True, seed: int = 0,
-                 workers_mode: str = "thread", mp_start: str = "spawn"):
+                 workers_mode: str = "thread", mp_start: str = "spawn",
+                 leased: bool = False, lease_depth: Optional[int] = None,
+                 span_affinity: Optional[bool] = None):
+        from dptpu.envknob import env_bool, env_int
+
         if workers_mode not in ("thread", "process"):
             raise ValueError(
                 f"workers_mode={workers_mode!r} must be 'thread' or "
@@ -78,6 +92,22 @@ class DataLoader:
         self.seed = seed
         self.workers_mode = workers_mode
         self.mp_start = mp_start
+        # zero-copy leased handoff (process mode): opt-in — the consumer
+        # must release (DevicePrefetcher does) or advance promptly
+        self.leased = leased
+        self.lease_depth = (
+            lease_depth if lease_depth is not None
+            else env_int("DPTPU_LEASE_DEPTH", 2)
+        )
+        if self.lease_depth < 1:
+            raise ValueError(
+                f"DPTPU_LEASE_DEPTH={self.lease_depth} must be >= 1 "
+                f"extra ring slot"
+            )
+        self.span_affinity = (
+            span_affinity if span_affinity is not None
+            else env_bool("DPTPU_SPAN_AFFINITY", True)
+        )
         self._get = getattr(dataset, "get", None)
         self._get_into = getattr(dataset, "get_into", None)
         self._item_shape = None  # probed from the first sample
@@ -86,6 +116,7 @@ class DataLoader:
         self._prev_cache_counts = (0, 0)  # feed_stats interval baseline
         self._degraded = False  # process pool gave up → thread fallback
         self._supervision = {"pool_restarts": 0, "span_retries": 0}
+        self._copy_totals = {"bytes_copied": 0, "collects": 0}
         self._pool = (
             ThreadPoolExecutor(
                 max_workers=self.num_workers, thread_name_prefix="dptpu-data"
@@ -236,10 +267,16 @@ class DataLoader:
     def _epoch_process(self, chunks, epoch, ahead):
         """Process-mode epoch: drive the shared-memory slot ring
         (dptpu/data/shm.py) with the same submit-ahead/collect-in-order
-        cadence as the thread path. If the supervised pool exhausts its
-        restart budget (``WorkerPoolBroken``), degrade to thread mode for
-        the rest of the run instead of killing the job — batches are
-        bit-identical between modes, so the hand-off is seamless."""
+        cadence as the thread path. ``leased=True`` yields zero-copy slot
+        views carrying a ``"_lease"`` token; an after-yield backstop
+        reclaims any lease the consumer didn't release, so the ring keeps
+        flowing even for consumers unaware of the protocol (their batch
+        bytes are then only stable until they advance — retaining
+        consumers must use the copy path). If the supervised pool
+        exhausts its restart budget (``WorkerPoolBroken``), degrade to
+        thread mode for the rest of the run instead of killing the job —
+        batches are bit-identical between modes, so the hand-off is
+        seamless."""
         from dptpu.data.shm import WorkerPoolBroken
 
         if not chunks:
@@ -248,7 +285,8 @@ class DataLoader:
         nb = len(chunks)
         b = 0
         try:
-            pipe = self._ensure_pipeline(slots=ahead + 1)
+            slots = ahead + 1 + (self.lease_depth if self.leased else 0)
+            pipe = self._ensure_pipeline(slots=slots)
             pipe.reset()  # reclaim slots from an abandoned prior epoch
             pending = deque()
             for chunk, _ in chunks[:ahead]:
@@ -260,9 +298,18 @@ class DataLoader:
                     pending.append(pipe.submit(chunks[next_idx][0], epoch))
                     next_idx += 1
                 out_size = self.batch_size if self.pad_final else n_valid
-                imgs, labels = pipe.collect(slot, out_size)
-                yield self._assemble(imgs, labels, n_valid,
-                                     valid=chunks[b][1])
+                imgs, labels, lease = pipe.collect(
+                    slot, out_size, leased=self.leased
+                )
+                batch = self._assemble(imgs, labels, n_valid,
+                                       valid=chunks[b][1])
+                if lease is not None:
+                    batch["_lease"] = lease
+                yield batch
+                if lease is not None:
+                    # backstop: the consumer moved on without releasing
+                    # (no-op when DevicePrefetcher already did)
+                    lease.release()
         except WorkerPoolBroken as e:
             self._degrade_to_thread(str(e))
             # batch b was never yielded; re-decode from it on threads
@@ -275,6 +322,8 @@ class DataLoader:
         if self._pipeline is not None:
             for k, v in self._pipeline.supervision_stats().items():
                 self._supervision[k] += v
+            for k, v in self._pipeline.copy_stats().items():
+                self._copy_totals[k] += v
             self._pipeline.close()
             self._pipeline = None
 
@@ -313,7 +362,7 @@ class DataLoader:
             self._pipeline = ShmBatchPipeline(
                 self.dataset, self.batch_size, self._item_shape,
                 num_workers=self.num_workers, seed=self.seed, slots=slots,
-                mp_start=self.mp_start,
+                mp_start=self.mp_start, span_affinity=self.span_affinity,
             )
             # fresh workers count from zero: re-baseline the interval
             # hit-rate bookkeeping in feed_stats
@@ -345,8 +394,19 @@ class DataLoader:
         if self._degraded:
             stats["degraded"] = True
         if self.workers_mode == "process":
+            stats["leased"] = self.leased
+            stats["span_affinity"] = self.span_affinity
+            copied = dict(self._copy_totals)
             if self._pipeline is not None:
                 stats.update(self._pipeline.cache_stats())
+                for k, v in self._pipeline.copy_stats().items():
+                    copied[k] += v
+            # the zero-copy contract, measured: parent-side copy-out
+            # bytes per collected batch (0 when every collect was leased)
+            stats["bytes_copied_per_batch"] = (
+                copied["bytes_copied"] / copied["collects"]
+                if copied["collects"] else 0.0
+            )
         else:
             cache = getattr(self.dataset, "decode_cache", None)
             if cache is not None:
@@ -375,18 +435,55 @@ class DevicePrefetcher:
     overlaps the compiled step running on batch N — the DataPrefetcher's
     double-buffering (imagenet_ddp_apex.py:304-351) with zero custom
     stream code.
+
+    LEASED batches (a ``"_lease"`` token from the process-mode loader's
+    zero-copy path) are the prefetcher's responsibility to release —
+    only then may the shared-memory ring recycle the slot:
+
+    * on an accelerator backend, ``put`` DMAs the host views to device
+      memory; the prefetcher blocks until that transfer completes, then
+      releases — the blocking overlaps the PREVIOUS step's device
+      compute, and no host-side byte is ever copied;
+    * on the CPU backend, ``jax.device_put`` may ZERO-COPY ALIAS the
+      host buffer (measured on this toolchain: a mutated source mutates
+      the "device" array), so recycling after a mere block would corrupt
+      the batch mid-step. The prefetcher therefore copies the views once
+      before ``put`` and releases immediately — the same cost as the
+      legacy copy-out, paid only where physics offers no transfer.
+      ``copy_before_put`` overrides the backend auto-detection (tests
+      use it to drive the raw lease protocol with a custom ``put``).
     """
 
-    def __init__(self, batches: Iterator[dict], put=jax.device_put):
+    def __init__(self, batches: Iterator[dict], put=jax.device_put,
+                 copy_before_put: Optional[bool] = None):
         self._it = iter(batches)
         self._put = put
+        self._copy = copy_before_put
         self._next = self._advance()
 
     def _advance(self):
         try:
-            return self._put(next(self._it))
+            batch = next(self._it)
         except StopIteration:
             return None
+        lease = batch.pop("_lease", None)
+        if lease is None:
+            return self._put(batch)
+        if self._copy is None:
+            # CPU PJRT zero-copies suitably-shaped numpy buffers — the
+            # device array then aliases the ring slot for its lifetime
+            self._copy = jax.default_backend() == "cpu"
+        if self._copy:
+            batch = {k: np.array(v) for k, v in batch.items()}
+            out = self._put(batch)
+            lease.release()
+            return out
+        out = self._put(batch)
+        # the H2D read must finish before the slot may be overwritten;
+        # this wait overlaps the previous step's device compute
+        jax.block_until_ready(out)
+        lease.release()
+        return out
 
     def __iter__(self):
         return self

@@ -10,39 +10,55 @@ loader's preallocated batch memory itself:
 
 * a ring of ``slots`` batch buffers lives in ONE
   ``multiprocessing.shared_memory`` segment per array (images uint8
-  ``[slots, B, H, W, C]``, labels int32 ``[slots, B]``);
+  ``[slots, B, H, W, C]``, labels int32 ``[slots, B]``), named
+  ``dptpu_ring_*`` so /dev/shm hygiene checks can attribute them;
 * workers run the SAME span-decode path as thread mode
   (``dataset.get_into`` → the native decoder's caller-supplied output
   row), writing JPEG decodes directly into their slot's rows — pixels
-  never cross a pipe, only tiny ``(slot, offset, indices, epoch)`` tasks
-  and ``(done, ...)`` acks do;
+  never cross a pipe, only tiny ``(slot, task, offsets, indices,
+  epoch)`` tasks and ``(done, ...)`` acks do;
 * per-item augmentation RNG is derived from ``(seed, epoch, index)``
   exactly as in thread mode, so process and thread loaders yield
   BIT-IDENTICAL batches for the same seed (tests/test_shm_loader.py);
-* the parent copies a completed slot out once (so consumers own their
-  batches and the slot recycles immediately); that single memcpy is
-  ~1-2 ms against a >100 ms decode per batch.
+* CACHE-AFFINITY SPAN ROUTING: each worker owns a task queue, and
+  ``submit`` routes every sample index to the worker picked by a stable
+  hash of the index — so when the decode cache is per-worker sharded
+  (``DPTPU_CACHE_SCOPE=sharded``) the same worker re-decodes the same
+  images every epoch and its shard stays warm across reshuffles
+  (previously ~1/N of hits landed on the wrong shard and re-decoded).
+  Groups are rebalanced down to ``ceil(B/N)`` items so one hot worker
+  cannot serialize a batch — the moved items decode cold in sharded
+  scope and hit anyway in pooled scope;
+* ZERO-COPY HANDOFF: ``collect(leased=True)`` returns numpy VIEWS into
+  the slot plus a :class:`SlotLease`; the slot re-enters the free ring
+  only when the lease is released (``DevicePrefetcher`` releases it
+  after the device transfer of that batch completes), eliminating the
+  parent's per-batch copy-out entirely — ``feed_stats`` reports
+  ``bytes_copied_per_batch = 0``. The legacy copy-out path remains the
+  default for consumers that retain batches (``leased=False``).
 
 SUPERVISION (dptpu.resilience): the pool is watched, not trusted. Every
 result wait runs under a deadline (``DPTPU_WORKER_TIMEOUT_S``); a dead
 worker (OOM-kill, native crash, SIGKILL) or a silent hang triggers a pool
 restart — workers are killed, queues rebuilt, and every UNACKED span
-re-enqueued, which is safe because spans are deterministic pure writes
-into disjoint rows (re-decoding produces the same bytes). A span that
-ERRORS is retried ``DPTPU_SPAN_RETRIES`` times (covers transient I/O)
-before the worker's traceback is re-raised in the parent. After
-``DPTPU_POOL_RESTARTS`` CONSECUTIVE restarts without progress the pool
-raises :class:`WorkerPoolBroken`, and the DataLoader degrades to thread
-mode with a loud warning instead of killing a multi-hour job. An
-``atexit`` hook unlinks the SharedMemory segments of any pipeline the
-parent abandons without ``close()`` (an aborted run must not leak
-``/dev/shm`` until reboot).
+re-enqueued to its assigned worker, which is safe because spans are
+deterministic pure writes into disjoint rows (re-decoding produces the
+same bytes). A span that ERRORS is retried ``DPTPU_SPAN_RETRIES`` times
+(covers transient I/O) before the worker's traceback is re-raised in the
+parent. After ``DPTPU_POOL_RESTARTS`` CONSECUTIVE restarts without
+progress the pool raises :class:`WorkerPoolBroken`, and the DataLoader
+degrades to thread mode with a loud warning instead of killing a
+multi-hour job. An ``atexit`` hook unlinks the SharedMemory segments of
+any pipeline the parent abandons without ``close()`` (an aborted run
+must not leak ``/dev/shm`` until reboot).
 
 Workers are spawned (not forked) by default: the parent holds JAX/XLA
 runtime threads whose locks must not be forked mid-flight. Spawn pickles
-the dataset once per worker; a ``DecodeCache`` crosses that boundary as
-budget-only (each worker warms its own shard, budget divided by the pool
-size — see ``dptpu/data/cache.py``).
+the dataset once per worker; a sharded ``DecodeCache`` crosses that
+boundary as budget-only (each worker warms its own shard, budget divided
+by the pool size — see ``dptpu/data/cache.py``), while a pooled
+``ShmDecodeCache`` crosses as an attach spec to the one shared slab that
+also SURVIVES pool restarts warm (``dptpu/data/shm_cache.py``).
 """
 
 from __future__ import annotations
@@ -57,8 +73,12 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dptpu.data.dataset import _copy_checked
+from dptpu.data.shm_cache import close_segment, create_named_segment
 from dptpu.envknob import env_float, env_int
 from dptpu.resilience.faults import FaultPlan
+
+SEGMENT_PREFIX = "dptpu_ring"
 
 _LIVE_PIPELINES: "weakref.WeakSet" = weakref.WeakSet()
 _ATEXIT_REGISTERED = False
@@ -82,17 +102,83 @@ def _register_pipeline(pipe):
         _ATEXIT_REGISTERED = True
 
 
+def live_segment_names():
+    """Ring segment names owned by still-open pipelines in THIS process
+    (the conftest /dev/shm leak guard's allowlist)."""
+    out = set()
+    for pipe in list(_LIVE_PIPELINES):
+        if not pipe._closed:
+            out.add(pipe._shm_imgs.name.lstrip("/"))
+            out.add(pipe._shm_labels.name.lstrip("/"))
+    return out
+
+
 class WorkerPoolBroken(RuntimeError):
     """The pool failed ``max_restarts`` consecutive times — the caller
     should degrade to thread mode rather than keep flogging it."""
 
 
+def _affinity_of(index: int, num_workers: int) -> int:
+    """Stable index → worker hash (Fibonacci multiplicative): identical
+    across epochs, runs and pool restarts, so a worker's sharded cache
+    keeps seeing the same images no matter how the sampler reshuffles."""
+    return ((index * 2654435761) >> 7) % num_workers
+
+
+def _affinity_spans(batch_indices, num_workers: int):
+    """Split one batch into per-worker spans by index affinity, then
+    rebalance any group above ``ceil(B/N)`` down to the least-loaded
+    workers (the idle-worker fallback: utilization beats affinity for
+    the overflow items). Returns ``[(wid, offsets, indices), ...]``."""
+    n = len(batch_indices)
+    if num_workers <= 1:
+        return [(0, tuple(range(n)),
+                 tuple(int(i) for i in batch_indices))]
+    groups = [([], []) for _ in range(num_workers)]
+    for o, raw in enumerate(batch_indices):
+        idx = int(raw)
+        g = groups[_affinity_of(idx, num_workers)]
+        g[0].append(o)
+        g[1].append(idx)
+    cap = -(-n // num_workers)
+    sizes = [len(g[0]) for g in groups]
+    for w in range(num_workers):
+        while sizes[w] > cap:
+            t = min(range(num_workers), key=lambda k: sizes[k])
+            if sizes[t] >= cap:
+                break
+            groups[t][0].append(groups[w][0].pop())
+            groups[t][1].append(groups[w][1].pop())
+            sizes[w] -= 1
+            sizes[t] += 1
+    return [
+        (w, tuple(offs), tuple(idxs))
+        for w, (offs, idxs) in enumerate(groups)
+        if offs
+    ]
+
+
+def _contiguous_spans(batch_indices, num_workers: int):
+    """Legacy span split (affinity off): contiguous ceil(B/N) chunks,
+    chunk k → worker k."""
+    n = len(batch_indices)
+    span = -(-n // num_workers)
+    out = []
+    for k, o in enumerate(range(0, n, span)):
+        idxs = tuple(int(i) for i in batch_indices[o:o + span])
+        out.append((k % num_workers, tuple(range(o, o + len(idxs))), idxs))
+    return out
+
+
 def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
                  batch_size, item_shape, seed, num_workers, task_q, res_q):
-    """Decode-worker loop: pull ``(slot, offset, indices, epoch)`` spans,
-    write pixels/labels straight into the shared ring, ack on ``res_q``.
+    """Decode-worker loop: pull ``(slot, task, offsets, indices, epoch)``
+    spans from THIS worker's queue, write pixels/labels straight into the
+    shared ring, ack on ``res_q``.
 
-    Runs in a spawned child — keep imports local and never touch JAX.
+    Runs in a spawned child — keep imports local and never touch JAX
+    (``_copy_checked`` comes from the module import: dataset.py is
+    numpy/stdlib-only, so hoisting it out of the hot loop is safe).
     """
     from multiprocessing import shared_memory
 
@@ -110,6 +196,8 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
     cache = getattr(dataset, "decode_cache", None)
     if cache is not None and num_workers > 1:
         # keep the configured cache_bytes a TOTAL budget across the pool
+        # (a pooled ShmDecodeCache makes this a documented no-op: its
+        # slab is already one shared budget)
         cache.scale_budget(num_workers)
     get_into = getattr(dataset, "get_into", None)
     get = getattr(dataset, "get", None)
@@ -124,29 +212,28 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
             task = task_q.get()
             if task is None:
                 break
-            slot, offset, idxs, epoch = task
+            slot, task_id, offsets, idxs, epoch = task
             try:
-                for j, index in enumerate(idxs):
+                for off, index in zip(offsets, idxs):
                     if fault_plan is not None:
                         fault_plan.worker_decode_hook(worker_id, index)
                     rng = np.random.default_rng([seed, epoch, index])
-                    row = imgs[slot, offset + j]
+                    row = imgs[slot, off]
                     if get_into is not None:
-                        labels[slot, offset + j] = get_into(index, rng, row)
+                        labels[slot, off] = get_into(index, rng, row)
                     else:
-                        from dptpu.data.dataset import _copy_checked
-
                         if get is not None:
                             img, lab = get(index, rng)
                         else:
                             img, lab = dataset[index]
                         _copy_checked(row, img, index)
-                        labels[slot, offset + j] = lab
+                        labels[slot, off] = lab
                 hits, misses = (cache.hits, cache.misses) if cache else (0, 0)
-                res_q.put(("done", worker_id, slot, offset, hits, misses))
+                res_q.put(("done", worker_id, slot, task_id, hits, misses))
             except BaseException:
                 res_q.put(
-                    ("error", worker_id, slot, offset, traceback.format_exc())
+                    ("error", worker_id, slot, task_id,
+                     traceback.format_exc())
                 )
     except (EOFError, OSError, KeyboardInterrupt):
         pass  # parent went away / interrupt: exit quietly
@@ -156,19 +243,46 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
         shm_labels.close()
 
 
+class SlotLease:
+    """Consumer-held claim on one ring slot: the views ``collect``
+    returned stay byte-stable until ``release()``. Releasing twice (or
+    after the ring reset/retired the slot underneath — the generation
+    check) is a no-op, so the DataLoader's after-yield backstop and the
+    DevicePrefetcher's after-transfer release compose safely."""
+
+    __slots__ = ("_pipe", "slot", "_gen", "released")
+
+    def __init__(self, pipe, slot: int, gen: int):
+        self._pipe = pipe
+        self.slot = slot
+        self._gen = gen
+        self.released = False
+
+    def release(self):
+        if self.released:
+            return
+        self.released = True
+        self._pipe._release_slot(self.slot, self._gen)
+
+
 class ShmBatchPipeline:
     """The process-mode backend of ``DataLoader``: shared-memory slot ring
-    + supervised persistent worker pool + span task/ack queues.
+    + supervised persistent worker pool + per-worker task queues (span
+    affinity) + one shared ack queue.
 
     Protocol (driven by ``DataLoader._epoch_process``): ``submit`` fans a
     batch's indices out as one span task per worker into a free slot;
-    ``collect`` blocks until that slot's spans are acked, copies the rows
-    out, and recycles the slot. ``reset`` drains an abandoned epoch's
-    in-flight work so the ring starts an epoch fully free.
+    ``collect`` blocks until that slot's spans are acked, then either
+    copies the rows out and recycles the slot immediately (legacy), or —
+    ``leased=True`` — hands back zero-copy views plus a
+    :class:`SlotLease` and recycles only on release. ``reset`` drains an
+    abandoned epoch's in-flight work, revokes outstanding leases (their
+    late ``release()`` calls no-op via the generation check) and marks
+    every slot free.
 
-    Supervision bookkeeping: ``_pending[slot][offset] = task`` holds every
-    unacked span — exactly what a pool restart must re-enqueue; it is the
-    single source of truth for "work the consumer is still owed".
+    Supervision bookkeeping: ``_pending[slot][task_id] = task`` holds
+    every unacked span — exactly what a pool restart must re-enqueue; it
+    is the single source of truth for "work the consumer is still owed".
     """
 
     def __init__(self, dataset, batch_size: int, item_shape: Tuple[int, ...],
@@ -176,14 +290,15 @@ class ShmBatchPipeline:
                  mp_start: str = "spawn",
                  timeout_s: Optional[float] = None,
                  max_restarts: Optional[int] = None,
-                 span_retries: Optional[int] = None):
+                 span_retries: Optional[int] = None,
+                 span_affinity: bool = True):
         import multiprocessing as mp
-        from multiprocessing import shared_memory
 
         self.batch_size = batch_size
         self.item_shape = tuple(int(d) for d in item_shape)
         self.num_workers = max(1, num_workers)
         self.slots = max(2, slots)
+        self.span_affinity = span_affinity
         self._dataset = dataset
         self._seed = seed
         self._has_cache = getattr(dataset, "decode_cache", None) is not None
@@ -211,11 +326,12 @@ class ShmBatchPipeline:
             )
         item_bytes = int(np.prod(self.item_shape))
         self._ctx = mp.get_context(mp_start)
-        self._shm_imgs = shared_memory.SharedMemory(
-            create=True, size=max(1, self.slots * batch_size * item_bytes)
+        self._shm_imgs = create_named_segment(
+            SEGMENT_PREFIX,
+            max(1, self.slots * batch_size * item_bytes),
         )
-        self._shm_labels = shared_memory.SharedMemory(
-            create=True, size=self.slots * batch_size * 4
+        self._shm_labels = create_named_segment(
+            SEGMENT_PREFIX, self.slots * batch_size * 4
         )
         self._imgs = np.ndarray(
             (self.slots, batch_size) + self.item_shape, np.uint8,
@@ -225,13 +341,18 @@ class ShmBatchPipeline:
             (self.slots, batch_size), np.int32, buffer=self._shm_labels.buf
         )
         self._outstanding = [0] * self.slots  # span acks still in flight
-        self._pending = {s: {} for s in range(self.slots)}  # offset -> task
-        self._retries = {}  # (slot, offset) -> attempts so far
+        self._pending = {s: {} for s in range(self.slots)}  # task_id -> task
+        self._retries = {}  # (slot, task_id) -> attempts so far
         self._free = list(range(self.slots))
+        self._leased = set()  # slots held by unreleased SlotLeases
+        self._slot_gen = [0] * self.slots  # stale-lease guard
         self._worker_cache = {}  # worker_id -> latest (hits, misses)
+        self._cache_base = [0, 0]  # counts folded in from killed pools
         self._restarts_total = 0
         self._span_retries_total = 0
         self._consec_failures = 0
+        self._bytes_copied = 0  # parent-side copy-out bytes (legacy path)
+        self._collects = 0
         self._closed = False
         self._start_workers()
         _register_pipeline(self)
@@ -240,7 +361,7 @@ class ShmBatchPipeline:
         """(Re)create the task/ack queues and spawn the worker pool —
         queues are rebuilt with the pool because a SIGKILLed worker can
         leave a queue's internal pipe in a torn state."""
-        self._task_q = self._ctx.Queue()
+        self._task_qs = [self._ctx.Queue() for _ in range(self.num_workers)]
         self._res_q = self._ctx.Queue()
         self._procs = [
             self._ctx.Process(
@@ -248,7 +369,7 @@ class ShmBatchPipeline:
                 args=(wid, self._dataset, self._shm_imgs.name,
                       self._shm_labels.name, self.slots, self.batch_size,
                       self.item_shape, self._seed, self.num_workers,
-                      self._task_q, self._res_q),
+                      self._task_qs[wid], self._res_q),
                 daemon=True,
                 name=f"dptpu-data-{wid}",
             )
@@ -260,43 +381,71 @@ class ShmBatchPipeline:
     # -- submission / collection -------------------------------------------
 
     def submit(self, batch_indices, epoch: int) -> Tuple[int, int]:
-        """Fan one batch out as span tasks into a free slot; returns
-        ``(slot, n_valid)``. The caller's prefetch depth must not exceed
-        ``slots`` (DataLoader sizes the ring accordingly)."""
+        """Fan one batch out as affinity-routed span tasks into a free
+        slot; returns ``(slot, n_valid)``. The caller's prefetch depth
+        plus its unreleased leases must not exceed ``slots`` (DataLoader
+        sizes the ring accordingly)."""
         if not self._free:
             raise RuntimeError(
-                f"no free batch slot (ring of {self.slots}, all in "
-                f"flight) — prefetch depth exceeded the ring size"
+                f"no free batch slot (ring of {self.slots}, "
+                f"{len(self._leased)} leased, rest in flight) — prefetch "
+                f"depth plus unreleased leases exceeded the ring size"
             )
         slot = self._free.pop()
-        n = len(batch_indices)
-        span = -(-n // self.num_workers)
-        for o in range(0, n, span):
-            task = (slot, o,
-                    tuple(int(i) for i in batch_indices[o:o + span]), epoch)
-            self._pending[slot][o] = task
-            self._task_q.put(task)
+        spans = (
+            _affinity_spans(batch_indices, self.num_workers)
+            if self.span_affinity
+            else _contiguous_spans(batch_indices, self.num_workers)
+        )
+        for task_id, (wid, offsets, idxs) in enumerate(spans):
+            task = (slot, task_id, offsets, idxs, epoch, wid)
+            self._pending[slot][task_id] = task
+            self._task_qs[wid].put(task[:5])
         self._outstanding[slot] = len(self._pending[slot])
-        return slot, n
+        return slot, len(batch_indices)
 
-    def collect(self, slot: int, out_rows: int):
-        """Wait for ``slot``'s spans, copy ``out_rows`` rows out (consumer
-        owns the copies), recycle the slot. Raises the worker's decode
-        error, with its traceback, once its retry budget is spent."""
+    def collect(self, slot: int, out_rows: int, leased: bool = False):
+        """Wait for ``slot``'s spans, then hand the rows to the consumer:
+        ``leased=False`` copies them out (consumer owns the copies, slot
+        recycles immediately); ``leased=True`` returns zero-copy VIEWS
+        plus a :class:`SlotLease` — the slot recycles only on
+        ``lease.release()``. Raises the worker's decode error, with its
+        traceback, once its retry budget is spent."""
         while self._outstanding[slot] > 0:
             self._handle(self._next_result(), mode="normal")
+        self._collects += 1
+        if leased:
+            self._leased.add(slot)
+            return (self._imgs[slot, :out_rows],
+                    self._labels[slot, :out_rows],
+                    SlotLease(self, slot, self._slot_gen[slot]))
         imgs = np.array(self._imgs[slot, :out_rows])
         labels = np.array(self._labels[slot, :out_rows])
+        self._bytes_copied += imgs.nbytes + labels.nbytes
         self._free.append(slot)
-        return imgs, labels
+        return imgs, labels, None
+
+    def _release_slot(self, slot: int, gen: int):
+        """SlotLease callback: recycle a leased slot. Generation-checked
+        so a lease that outlived a ``reset``/``close`` (abandoned epoch,
+        degrade-to-thread) is silently void instead of double-freeing."""
+        if self._closed or gen != self._slot_gen[slot] \
+                or slot not in self._leased:
+            return
+        self._leased.discard(slot)
+        self._slot_gen[slot] += 1
+        self._free.append(slot)
 
     def reset(self):
         """Reclaim the ring after an abandoned epoch: wait out (or, on a
-        restart, simply drop) in-flight work and mark every slot free.
-        Errors for batches nobody will consume are discarded."""
+        restart, simply drop) in-flight work, revoke outstanding leases,
+        and mark every slot free. Errors for batches nobody will consume
+        are discarded."""
         while any(self._outstanding):
             self._handle(self._next_result(requeue=False), mode="discard")
         self._free = list(range(self.slots))
+        self._leased.clear()
+        self._slot_gen = [g + 1 for g in self._slot_gen]
         for spans in self._pending.values():
             spans.clear()
         self._retries.clear()
@@ -325,8 +474,8 @@ class ShmBatchPipeline:
         deadline with zero progress restarts the pool (re-enqueueing the
         unacked spans unless ``requeue`` is off — the reset path drops
         them instead). Liveness is checked BEFORE every wait, not only on
-        timeout: a worker that dies idle (its spans picked up by the
-        survivors) would otherwise silently shrink the pool forever."""
+        timeout: a worker that dies idle would otherwise silently shrink
+        the pool forever."""
         deadline = time.monotonic() + self.timeout_s
         while True:
             dead = [p for p in self._procs if not p.is_alive()]
@@ -358,8 +507,10 @@ class ShmBatchPipeline:
             deadline = time.monotonic() + self.timeout_s
 
     def _restart_pool(self, reason: str, requeue: bool = True):
-        """Kill + respawn the pool; re-enqueue every unacked span (safe:
-        spans are deterministic pure writes into disjoint rows)."""
+        """Kill + respawn the pool; re-enqueue every unacked span to its
+        assigned worker (safe: spans are deterministic pure writes into
+        disjoint rows — and a pooled decode cache slab survives the
+        restart warm, since it belongs to the parent's dataset)."""
         self._consec_failures += 1
         if self._consec_failures > self.max_restarts:
             raise WorkerPoolBroken(
@@ -393,17 +544,26 @@ class ShmBatchPipeline:
                 self._handle(msg, mode="normal")
             # drained error acks stay pending: the restart re-enqueues
             # them, which is exactly a retry
-        for q in (self._task_q, self._res_q):
+        for q in self._task_qs + [self._res_q]:
             try:
                 q.close()
                 q.cancel_join_thread()
             except Exception:
                 pass
+        # respawned workers count hits/misses from zero: fold the dead
+        # pool's last-known counts into a base so the cumulative numbers
+        # feed_stats differences stay MONOTONIC across restarts (else a
+        # warm post-restart epoch reads a bogus 0.0 interval hit rate)
+        self._cache_base[0] += sum(
+            h for h, _ in self._worker_cache.values())
+        self._cache_base[1] += sum(
+            m for _, m in self._worker_cache.values())
+        self._worker_cache.clear()
         self._start_workers()
         if requeue:
             for spans in self._pending.values():
                 for task in spans.values():
-                    self._task_q.put(task)
+                    self._task_qs[task[5]].put(task[:5])
         else:
             for spans in self._pending.values():
                 spans.clear()
@@ -417,36 +577,36 @@ class ShmBatchPipeline:
         kind = msg[0]
         if kind == "none":  # restart-with-drop sentinel from _next_result
             return
-        worker_id, slot, offset = msg[1], msg[2], msg[3]
+        worker_id, slot, task_id = msg[1], msg[2], msg[3]
         if kind == "done":
             self._consec_failures = 0  # the pool is making progress
             self._outstanding[slot] -= 1
-            self._pending[slot].pop(offset, None)
-            self._retries.pop((slot, offset), None)
+            self._pending[slot].pop(task_id, None)
+            self._retries.pop((slot, task_id), None)
             self._worker_cache[worker_id] = (msg[4], msg[5])
             return
         # kind == "error"
         if mode == "discard":
             self._outstanding[slot] -= 1
-            self._pending[slot].pop(offset, None)
-            self._retries.pop((slot, offset), None)
+            self._pending[slot].pop(task_id, None)
+            self._retries.pop((slot, task_id), None)
             return
-        attempts = self._retries.get((slot, offset), 0)
-        task = self._pending[slot].get(offset)
+        attempts = self._retries.get((slot, task_id), 0)
+        task = self._pending[slot].get(task_id)
         if attempts < self.span_retries and task is not None:
-            self._retries[(slot, offset)] = attempts + 1
+            self._retries[(slot, task_id)] = attempts + 1
             self._span_retries_total += 1
             print(
                 f"WARNING: dptpu data worker {worker_id} errored on batch "
-                f"slot {slot} offset {offset}; retrying span "
+                f"slot {slot} span {task_id}; retrying span "
                 f"({attempts + 1}/{self.span_retries})",
                 file=sys.stderr,
             )
-            self._task_q.put(task)
+            self._task_qs[task[5]].put(task[:5])
             return
         raise RuntimeError(
             f"data worker {worker_id} failed while decoding (batch "
-            f"slot {slot}, offset {offset}"
+            f"slot {slot}, span {task_id}"
             + (f", after {attempts} retries" if attempts else "")
             + f"); worker traceback:\n{msg[4]}"
         )
@@ -459,14 +619,19 @@ class ShmBatchPipeline:
         ``done`` message — no extra round trip)."""
         if not self._has_cache:
             return {}
-        hits = sum(h for h, _ in self._worker_cache.values())
-        misses = sum(m for _, m in self._worker_cache.values())
+        hits = self._cache_base[0] + sum(
+            h for h, _ in self._worker_cache.values())
+        misses = self._cache_base[1] + sum(
+            m for _, m in self._worker_cache.values())
         total = hits + misses
-        return {
+        stats = {
             "cache_hits": hits,
             "cache_misses": misses,
             "cache_hit_rate": (hits / total) if total else 0.0,
         }
+        scope = getattr(self._dataset.decode_cache, "scope", "sharded")
+        stats["cache_scope"] = scope
+        return stats
 
     def supervision_stats(self) -> dict:
         """Watchdog counters for feed telemetry."""
@@ -475,18 +640,26 @@ class ShmBatchPipeline:
             "span_retries": self._span_retries_total,
         }
 
+    def copy_stats(self) -> dict:
+        """Parent-side copy-out accounting: ``bytes_copied`` stays 0 when
+        every collect was leased (the zero-copy contract the feed_stats
+        ``bytes_copied_per_batch`` field reports)."""
+        return {
+            "bytes_copied": self._bytes_copied,
+            "collects": self._collects,
+        }
+
     # -- lifecycle ----------------------------------------------------------
 
     def close(self):
         if self._closed:
             return
         self._closed = True
-        for p in self._procs:
-            if p.is_alive():
-                try:
-                    self._task_q.put(None)
-                except Exception:
-                    pass
+        for q in self._task_qs:
+            try:
+                q.put(None)
+            except Exception:
+                pass
         for p in self._procs:
             p.join(timeout=1.0)
             if p.is_alive():
@@ -495,7 +668,7 @@ class ShmBatchPipeline:
             if p.is_alive():  # hung in non-interruptible state: no mercy
                 p.kill()
                 p.join(timeout=2.0)
-        for q in (self._task_q, self._res_q):
+        for q in self._task_qs + [self._res_q]:
             try:
                 q.close()
                 q.cancel_join_thread()
@@ -503,11 +676,10 @@ class ShmBatchPipeline:
                 pass
         self._imgs = self._labels = None  # release buffer exports first
         for shm in (self._shm_imgs, self._shm_labels):
-            try:
-                shm.close()
-                shm.unlink()
-            except FileNotFoundError:
-                pass
+            # an unreleased lease view makes mmap.close() raise
+            # BufferError; the NAME is unlinked regardless, so nothing
+            # outlives the process (see shm_cache.close_segment)
+            close_segment(shm, unlink=True)
         _LIVE_PIPELINES.discard(self)
 
     def __del__(self):

@@ -44,3 +44,43 @@ def env_float(name: str, default: Optional[float] = None,
         raise ValueError(
             f"{name}={raw!r} is not a number (expected e.g. {name}=2.5)"
         ) from None
+
+
+def env_choice(name: str, choices, default: Optional[str] = None,
+               environ=None) -> Optional[str]:
+    """Enumerated env knob; unset/empty → ``default``, any explicit value
+    must be one of ``choices`` or the knob raises with the accepted set."""
+    raw = (environ if environ is not None else os.environ).get(
+        name, "").strip()
+    if not raw:
+        return default
+    if raw not in choices:
+        raise ValueError(
+            f"{name}={raw!r} must be one of "
+            + "/".join(repr(c) for c in choices)
+        )
+    return raw
+
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def env_bool(name: str, default: Optional[bool] = None,
+             environ=None) -> Optional[bool]:
+    """Boolean env knob; unset/empty → ``default``, anything outside the
+    1/0/true/false/yes/no/on/off vocabulary → actionable error (the same
+    fail-fast contract as the numeric knobs — a typo'd 'flase' must not
+    silently mean anything)."""
+    raw = (environ if environ is not None else os.environ).get(
+        name, "").strip().lower()
+    if not raw:
+        return default
+    if raw in _TRUE:
+        return True
+    if raw in _FALSE:
+        return False
+    raise ValueError(
+        f"{name}={raw!r} is not a boolean (expected e.g. {name}=1 or "
+        f"{name}=0)"
+    )

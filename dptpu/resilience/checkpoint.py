@@ -145,11 +145,21 @@ class CheckpointManager:
     and prunes rotated files beyond ``keep`` (oldest first; the
     epoch-boundary ``checkpoint.pth.tar``/``model_best`` are never
     rotation victims).
+
+    With an ``async_writer`` (dptpu.train.checkpoint
+    .AsyncCheckpointWriter), cadence saves run entirely on the writer
+    thread — device_get included — so ``--ckpt-steps`` stops stalling
+    the step loop. ``sync=True`` (emergency/preemption saves) first
+    drains the writer, then writes on the calling thread: the
+    newest-mtime file the resume scanner trusts is always the true
+    latest position, and a preempting process never exits before its
+    final save is durable.
     """
 
     def __init__(self, directory: str = ".", keep: int = 3,
                  is_chief: bool = True, arch: str = "",
-                 batch_size: Optional[int] = None, fault_plan=None):
+                 batch_size: Optional[int] = None, fault_plan=None,
+                 async_writer=None):
         if keep < 1:
             raise ValueError(f"ckpt keep={keep} must be >= 1")
         self.directory = directory
@@ -158,32 +168,64 @@ class CheckpointManager:
         self.arch = arch
         self.batch_size = batch_size
         self.fault_plan = fault_plan
+        self.async_writer = async_writer
 
     def save_step(self, state, *, epoch: int, step_in_epoch: int,
-                  best_acc1: float = 0.0) -> Optional[str]:
+                  best_acc1: float = 0.0, sync: bool = False
+                  ) -> Optional[str]:
         from dptpu.train.checkpoint import save_checkpoint
 
         if not self.is_chief:
             return None
-        path = save_checkpoint(
-            state,
-            epoch=epoch,
-            arch=self.arch,
-            best_acc1=best_acc1,
-            is_best=False,
-            directory=self.directory,
-            is_chief=True,
-            filename=step_checkpoint_name(epoch, step_in_epoch),
-            step_in_epoch=step_in_epoch,
-            data_position=(
-                step_in_epoch * self.batch_size
-                if self.batch_size is not None else None
-            ),
-        )
-        if self.fault_plan is not None:
-            self.fault_plan.on_checkpoint_saved(path)
-        self._rotate()
+        filename = step_checkpoint_name(epoch, step_in_epoch)
+        path = os.path.join(self.directory, filename)
+        run_async = self.async_writer is not None and not sync
+        if run_async:
+            import jax
+
+            # the train step DONATES the old state's buffers to the next
+            # step, so an enqueued snapshot must not reference them: take
+            # device-side copies (async dispatch, ordered BEFORE the
+            # donating step). The step loop still never blocks on a host
+            # gather — the writer thread pays the device_get.
+            state = jax.tree_util.tree_map(
+                lambda x: x.copy() if hasattr(x, "copy") else x, state
+            )
+
+        def _write():
+            save_checkpoint(
+                state,
+                epoch=epoch,
+                arch=self.arch,
+                best_acc1=best_acc1,
+                is_best=False,
+                directory=self.directory,
+                is_chief=True,
+                filename=filename,
+                step_in_epoch=step_in_epoch,
+                data_position=(
+                    step_in_epoch * self.batch_size
+                    if self.batch_size is not None else None
+                ),
+            )
+            if self.fault_plan is not None:
+                # fault hooks (ckpt_truncate@save=N) count ACTUAL writes
+                # in write order, so they ride the writer thread too
+                self.fault_plan.on_checkpoint_saved(path)
+            self._rotate()
+
+        if run_async:
+            self.async_writer.submit(_write)
+            return path
+        if self.async_writer is not None:
+            self.async_writer.flush()  # keep mtime order == save order
+        _write()
         return path
+
+    def flush(self):
+        """Drain any queued async saves (no-op without a writer)."""
+        if self.async_writer is not None:
+            self.async_writer.flush()
 
     def _rotate(self):
         # prune by mtime (save order), NOT by (epoch, step): after a

@@ -25,8 +25,10 @@ LR schedule resumes on the reference's epoch boundary.
 from __future__ import annotations
 
 import os
+import queue
 import shutil
 import struct
+import threading
 import zlib
 from typing import Optional
 
@@ -81,6 +83,79 @@ def split_payload(raw: bytes, path: str = "<bytes>") -> tuple:
             )
         return payload, True
     return raw, False
+
+
+class AsyncCheckpointWriter:
+    """One background thread that performs whole checkpoint saves —
+    device_get + serialize + CRC + fsync + rename — off the step thread.
+
+    ``--ckpt-steps`` at small N used to cost a device_get stall per save
+    (the gather drains the dispatch queue and the step loop eats the
+    ~100 ms refill, PERF.md); submitting the save here lets the step
+    loop keep dispatching while the writer thread blocks on the gather.
+    JAX arrays are immutable values, so the enqueued state is a
+    consistent snapshot no matter how far the step thread races ahead.
+
+    Guarantees:
+
+    * FIFO — saves land in submission order (one thread, one queue);
+    * bounded memory — at most ``max_pending`` snapshots queued
+      (``submit`` blocks beyond that: backpressure, not OOM);
+    * error surfacing — a failed write re-raises on the NEXT
+      ``submit``/``flush``/``close``, never silently;
+    * ``flush()`` drains the queue — emergency/preemption saves call it
+      first and then write SYNCHRONOUSLY, so the newest-mtime file the
+      resume scanner picks is always the true latest position.
+    """
+
+    def __init__(self, max_pending: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dptpu-ckpt-writer"
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            fn = self._q.get()
+            try:
+                if fn is None:
+                    return
+                fn()
+            except BaseException as e:  # surfaced on the next call-in
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        exc, self._exc = self._exc, None
+        if exc is not None:
+            raise RuntimeError(
+                "async checkpoint write failed (surfacing on the next "
+                "checkpoint call — the failed file never replaced a "
+                "good one: writes are tmp+rename)"
+            ) from exc
+
+    def submit(self, fn) -> None:
+        """Enqueue one save closure; blocks when ``max_pending`` saves
+        are already in flight (bounded snapshot memory)."""
+        self._raise_pending()
+        if not self._thread.is_alive():
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        self._q.put(fn)
+
+    def flush(self) -> None:
+        """Block until every queued save has hit disk."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain, stop the thread, surface any pending error."""
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join()
+        self._raise_pending()
 
 
 def save_checkpoint(

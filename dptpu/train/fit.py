@@ -17,6 +17,7 @@ Differences by design (TPU-first):
 
 from __future__ import annotations
 
+import sys
 import time
 from functools import partial
 from typing import Optional
@@ -87,7 +88,8 @@ def _axis_env_knob(name: str, what: str) -> int:
     return n or 0
 
 
-def _build_datasets(cfg: Config, image_size: int, cache_bytes: int = 0):
+def _build_datasets(cfg: Config, image_size: int, cache_bytes: int = 0,
+                    cache_scope: str = "sharded"):
     import os
 
     if cfg.data.startswith("synthetic"):
@@ -101,33 +103,46 @@ def _build_datasets(cfg: Config, image_size: int, cache_bytes: int = 0):
     # their own decoded-pixel cache (val redecodes the same files every
     # epoch, so it benefits at least as much per byte)
     train_ds = ImageFolderDataset(
-        traindir, train_transform(image_size), cache_bytes=cache_bytes
+        traindir, train_transform(image_size), cache_bytes=cache_bytes,
+        cache_scope=cache_scope,
     )
     val_ds = ImageFolderDataset(
         valdir, val_transform(image_size, resize=int(image_size * 256 / 224)),
-        cache_bytes=cache_bytes,
+        cache_bytes=cache_bytes, cache_scope=cache_scope,
     )
     return train_ds, val_ds, len(train_ds.classes)
 
 
 def _feed_knobs() -> tuple:
     """The input-pipeline env knobs, under the locked fail-fast contract:
-    every explicit-but-invalid value raises with the accepted values."""
-    import os
+    every explicit-but-invalid value raises with the accepted values.
 
-    workers_mode = os.environ.get("DPTPU_WORKERS_MODE", "").strip() or "thread"
-    if workers_mode not in ("thread", "process"):
-        raise ValueError(
-            f"DPTPU_WORKERS_MODE={workers_mode!r} must be 'thread' or "
-            f"'process'"
-        )
+    Returns ``(workers_mode, cache_bytes, cache_scope, leased)``:
+
+    * ``DPTPU_CACHE_SCOPE`` — ``pooled`` (one cross-process /dev/shm
+      slab, the process-mode default) or ``sharded`` (in-process
+      ``DecodeCache``, split N ways by a worker pool; the thread-mode
+      default, where in-process already means pooled);
+    * ``DPTPU_LEASE`` — zero-copy consumer-leased batch slots in process
+      mode (default on; the copy-out path remains for ``=0``).
+    """
+    from dptpu.envknob import env_bool, env_choice
+
+    workers_mode = env_choice(
+        "DPTPU_WORKERS_MODE", ("thread", "process"), default="thread"
+    )
     cache_bytes = _os_environ_int("DPTPU_CACHE_BYTES")
     if cache_bytes is not None and cache_bytes < 0:
         raise ValueError(
             f"DPTPU_CACHE_BYTES={cache_bytes} must be >= 0 bytes "
             f"(0/unset disables the decode cache)"
         )
-    return workers_mode, cache_bytes or 0
+    cache_scope = env_choice(
+        "DPTPU_CACHE_SCOPE", ("pooled", "sharded"),
+        default="pooled" if workers_mode == "process" else "sharded",
+    )
+    leased = env_bool("DPTPU_LEASE", True)
+    return workers_mode, cache_bytes or 0, cache_scope, leased
 
 
 def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
@@ -293,17 +308,21 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # DPTPU_WORKERS_MODE=process routes decode through the shared-memory
     # worker-process ring (dptpu/data/shm.py) — same batches bit-for-bit,
     # but decode scales with host cores instead of the GIL; DPTPU_CACHE_BYTES
-    # budgets a decoded-pixel cache so epoch 1+ skips JPEG Huffman decode.
-    workers_mode, cache_bytes = _feed_knobs()
+    # budgets a decoded-pixel cache so epoch 1+ skips JPEG Huffman decode
+    # (DPTPU_CACHE_SCOPE picks pooled-slab vs per-worker-sharded), and
+    # DPTPU_LEASE keeps process-mode batches zero-copy end to end.
+    workers_mode, cache_bytes, cache_scope, leased = _feed_knobs()
     if verbose and (workers_mode != "thread" or cache_bytes):
         print(
             f"=> input pipeline: workers_mode={workers_mode}, "
             f"decode cache "
-            + (f"{cache_bytes / 1e6:.0f} MB per dataset"
+            + (f"{cache_bytes / 1e6:.0f} MB per dataset ({cache_scope})"
                if cache_bytes else "off")
+            + (", leased slots" if leased and workers_mode == "process"
+               else "")
         )
     train_ds, val_ds, num_classes = _build_datasets(
-        cfg, image_size, cache_bytes=cache_bytes
+        cfg, image_size, cache_bytes=cache_bytes, cache_scope=cache_scope
     )
 
     # per-host loaders over disjoint shards (DistributedSampler contract);
@@ -327,6 +346,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         pad_final=False,
         seed=cfg.seed if cfg.seed is not None else 0,
         workers_mode=workers_mode,
+        leased=leased,
     )
     # Validation sharding follows the reference's split behavior:
     # * ddp/nd validate the FULL val set on every rank with no cross-rank
@@ -353,6 +373,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         ),
         num_workers=derived.workers_per_device * derived.local_device_count,
         workers_mode=workers_mode,
+        leased=leased,
     )
     val_count_divisor = derived.num_processes if full_val else 1
     steps_per_epoch = max(len(train_loader), 1)
@@ -669,6 +690,19 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # save a mid-epoch checkpoint, return cleanly → exit 0), and the
     # checkpoint manager rotates --ckpt-steps step saves so losing a
     # host costs at most ckpt_steps steps, not an epoch.
+    # --ckpt-steps cadence saves run on a background writer thread
+    # (device_get + serialize + fsync + rename all off the step loop —
+    # ROADMAP resilience follow-on (b)); emergency/preemption saves stay
+    # synchronous, draining the writer first so "newest file" == "latest
+    # position". DPTPU_ASYNC_CKPT=0 restores fully synchronous saves.
+    from dptpu.envknob import env_bool as _env_bool
+    from dptpu.train.checkpoint import AsyncCheckpointWriter
+
+    ckpt_writer = (
+        AsyncCheckpointWriter()
+        if cfg.ckpt_steps and _env_bool("DPTPU_ASYNC_CKPT", True)
+        else None
+    )
     manager = CheckpointManager(
         directory=ckpt_dir,
         keep=cfg.ckpt_keep,
@@ -676,6 +710,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         arch=cfg.arch,
         batch_size=host_batch,
         fault_plan=fault_plan,
+        async_writer=ckpt_writer,
     )
     if fault_plan is not None:
         fault_plan.bind_worker_kill(train_loader.kill_one_worker)
@@ -723,6 +758,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     path = manager.save_step(
                         eval_view(state), epoch=epoch,
                         step_in_epoch=start_step, best_acc1=best_acc1,
+                        sync=True,
                     )
                 result["preempted"] = True
                 if verbose:
@@ -737,14 +773,14 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     )
                 break
 
-            def _save_step(s, steps, _e=epoch):
+            def _save_step(s, steps, _e=epoch, sync=False):
                 return manager.save_step(
                     eval_view(s), epoch=_e, step_in_epoch=steps,
-                    best_acc1=best_acc1,
+                    best_acc1=best_acc1, sync=sync,
                 )
 
             def _emergency(s, steps, _e=epoch):
-                path = _save_step(s, steps, _e)
+                path = _save_step(s, steps, _e, sync=True)
                 # flag only AFTER the save succeeded: if it raised (disk
                 # full, transient I/O), the outer boundary fallback below
                 # still gets its own attempt
@@ -786,7 +822,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     path = manager.save_step(
                         eval_view(state), epoch=epoch,
                         step_in_epoch=train_stats["steps_done"],
-                        best_acc1=best_acc1,
+                        best_acc1=best_acc1, sync=True,
                     )
                 result["preempted"] = True
                 if verbose:
@@ -854,6 +890,13 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                         "Cache/hit_rate", train_stats["cache_hit_rate"],
                         epoch + 1,
                     )
+                if "bytes_copied_per_batch" in train_stats:
+                    # the zero-copy contract on a dashboard: parent-side
+                    # copy-out bytes per batch (0 under leased slots)
+                    writer.add_scalar(
+                        "Feed/bytes_copied_per_batch",
+                        train_stats["bytes_copied_per_batch"], epoch + 1,
+                    )
                 writer.add_scalar("Loss/train", train_stats["loss"], epoch + 1)
                 writer.add_scalar("Loss/val", val_stats["loss"], epoch + 1)
                 writer.add_scalar("Top1/train", train_stats["top1"], epoch + 1)
@@ -907,10 +950,25 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     epoch=current_pos["epoch"],
                     step_in_epoch=current_pos["step"],
                     best_acc1=best_acc1,
+                    sync=True,
                 )
             except Exception:
                 pass
         raise
+    finally:
+        if ckpt_writer is not None:
+            # exception paths already saved synchronously (which drains
+            # the queue); this close is loud on the NORMAL path — a
+            # failed cadence write must fail the run, not vanish.
+            # Probe for an in-flight exception BEFORE the close attempt:
+            # inside this except clause sys.exc_info() would report the
+            # close error itself, never None.
+            propagating = sys.exc_info()[0] is not None
+            try:
+                ckpt_writer.close()
+            except Exception:
+                if not propagating:
+                    raise
     if writer is not None:
         writer.close()
         # final wall-clock report (imagenet_ddp_apex.py:292-300)

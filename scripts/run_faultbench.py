@@ -16,7 +16,15 @@ synthetic data, each compared against one uninterrupted baseline run:
                       loader); the pool supervisor restarts it and the
                       run completes in one piece, bit-identical;
 * ``io_error``      — p=0.1 transient decode I/O errors; span retries
-                      absorb them, bit-identical.
+                      absorb them, bit-identical;
+* ``worker_kill_pooled`` — the round-7 feed path under chaos: real
+                      JPEGs through the POOLED cross-process decode
+                      slab (DPTPU_CACHE_SCOPE=pooled), cache-affinity
+                      span routing and leased zero-copy slots, with a
+                      worker SIGKILLed mid-run; must match a thread-mode
+                      cache-off baseline bit for bit (the slab survives
+                      the pool restart warm, and warm ≡ cold by the
+                      hit≡miss contract).
 
 Writes ``FAULTBENCH.json`` at the repo root: faults injected, recoveries
 (pool restarts / span retries / resume fallbacks), and the resume
@@ -50,7 +58,27 @@ from dptpu.train import fit  # noqa: E402
 
 _ENV_KNOBS = ("DPTPU_FAULT", "DPTPU_FAULT_SEED", "DPTPU_WORKERS_MODE",
               "DPTPU_SPAN_RETRIES", "DPTPU_WORKER_TIMEOUT_S",
-              "DPTPU_POOL_RESTARTS")
+              "DPTPU_POOL_RESTARTS", "DPTPU_CACHE_BYTES",
+              "DPTPU_CACHE_SCOPE", "DPTPU_LEASE")
+
+
+def make_jpeg_imagefolder(root, n_train, n_val, n_classes=2):
+    """Tiny 52×44 JPEGs (< 48·8/7, so the native scale picker stays at
+    8/8 and cache-on/off is bit-exact — the tests' fixture discipline)
+    in ImageFolder layout, for the pooled-slab chaos scenario."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for split, n in (("train", n_train), ("val", n_val)):
+        per = max(1, n // n_classes)
+        for c in range(n_classes):
+            d = os.path.join(root, split, f"class{c}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(per):
+                low = rng.randint(0, 255, (8, 7, 3), np.uint8)
+                img = Image.fromarray(low).resize((52, 44), Image.BILINEAR)
+                img.save(os.path.join(d, f"{i}.jpg"), quality=85)
 
 
 def run_fit(cfg, image_size, workdir, env=None):
@@ -205,6 +233,33 @@ def main():
         "recoveries": recoveries(r),
         "params_max_delta": params_max_delta(base["state"], r["state"]),
         "max_abs_dloss": trajectory_delta(base["history"], r["history"]),
+    })
+
+    # 5. worker_kill_pooled: the round-7 feed path (pooled /dev/shm
+    # decode slab + affinity routing + leased slots) chaos-tested on
+    # real JPEGs — its own thread-mode cache-off baseline, same seed
+    jpeg_root = os.path.join(root, "jpegs")
+    make_jpeg_imagefolder(jpeg_root, args.images, args.batch)
+    jcfg = cfg.replace(data=jpeg_root)
+    jbase = run_fit(jcfg, 48, os.path.join(root, "jpeg_baseline"))
+    d = os.path.join(root, "worker_kill_pooled")
+    r = run_fit(jcfg, 48, d,
+                env={"DPTPU_FAULT": f"worker_kill@step={kill_step}",
+                     "DPTPU_WORKERS_MODE": "process",
+                     "DPTPU_CACHE_BYTES": str(64 << 20),
+                     "DPTPU_CACHE_SCOPE": "pooled",
+                     "DPTPU_LEASE": "1"})
+    last = r["history"][-1] if r["history"] else {}
+    scenarios.append({
+        "name": "worker_kill_pooled",
+        "fault": f"worker_kill@step={kill_step}",
+        "preempted": bool(r["preempted"]),
+        "recoveries": recoveries(r),
+        "cache_hit_rate": float(last.get("train_cache_hit_rate", 0.0)),
+        "bytes_copied_per_batch": float(
+            last.get("train_bytes_copied_per_batch", -1.0)),
+        "params_max_delta": params_max_delta(jbase["state"], r["state"]),
+        "max_abs_dloss": trajectory_delta(jbase["history"], r["history"]),
     })
 
     for s in scenarios:

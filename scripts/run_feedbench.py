@@ -26,9 +26,17 @@ DPTPU_CACHE_BYTES → --workers-mode / --cache-mb) and records the loader
 telemetry fit() now reports per epoch (data_time, starvation, cache hit
 rate) — the numbers this script previously derived ad hoc.
 
+Round 7 adds the pooled-feed knobs: --cache-scope (pooled cross-process
+/dev/shm slab vs per-worker sharded split — DPTPU_CACHE_SCOPE) and
+--lease (consumer-leased zero-copy batch slots — DPTPU_LEASE), and
+records ``bytes_copied_per_batch`` per epoch: 0 proves the parent-side
+copy-out is gone end to end through fit().
+
 Usage: python scripts/run_feedbench.py [--images 1280] [--epochs 10]
                                        [--batch 64] [--workers-mode process]
                                        [--cache-mb 512]
+                                       [--cache-scope auto|pooled|sharded]
+                                       [--lease 1|0]
 """
 
 import argparse
@@ -86,6 +94,19 @@ def main():
         help="decode-cache budget per dataset (MB; 0 disables). Epoch "
              "1+ skips JPEG decode on hits.",
     )
+    ap.add_argument(
+        "--cache-scope", default="auto",
+        choices=("auto", "pooled", "sharded"),
+        help="decode-cache scope: pooled = one cross-process /dev/shm "
+             "slab every worker hits (process-mode default); sharded = "
+             "per-worker split of the budget; auto = fit()'s default "
+             "for the chosen workers-mode",
+    )
+    ap.add_argument(
+        "--lease", type=int, default=1, choices=(0, 1),
+        help="1 = consumer-leased zero-copy batch slots (process mode; "
+             "bytes_copied_per_batch = 0); 0 = legacy parent copy-out",
+    )
     ap.add_argument("--out", default="FEEDBENCH.json")
     args = ap.parse_args()
 
@@ -93,6 +114,9 @@ def main():
     # interface the CLIs use), so set them before importing/calling it
     os.environ["DPTPU_WORKERS_MODE"] = args.workers_mode
     os.environ["DPTPU_CACHE_BYTES"] = str(args.cache_mb << 20)
+    if args.cache_scope != "auto":
+        os.environ["DPTPU_CACHE_SCOPE"] = args.cache_scope
+    os.environ["DPTPU_LEASE"] = str(args.lease)
 
     from dptpu.config import Config
     from dptpu.data import native_image
@@ -142,6 +166,8 @@ def main():
     starv = float(np.mean([h["train_starvation"] for h in steady]))
     hit = float(np.mean([h.get("train_cache_hit_rate", 0.0)
                          for h in steady]))
+    copied = float(np.mean([h.get("train_bytes_copied_per_batch", 0.0)
+                            for h in steady]))
     rate = args.batch / bt if bt else 0.0
 
     steps_per_epoch = (args.images // args.batch)
@@ -157,7 +183,7 @@ def main():
         }
 
     out = {
-        "round": 6,
+        "round": 7,
         "what": ("fit() on real on-disk JPEGs, native decode, "
                  + ("real chip" if jax.default_backend() == "tpu"
                     else f"{jax.default_backend()} backend")),
@@ -171,6 +197,10 @@ def main():
         "batch_size": args.batch,
         "workers_mode": args.workers_mode,
         "cache_bytes": args.cache_mb << 20,
+        "cache_scope": (hist[-1].get("train_cache_scope")
+                        if hist else args.cache_scope),
+        "leased": bool(args.lease),
+        "bytes_copied_per_batch": round(copied, 1),
         "epochs": len(hist),
         "steps_total": steps_per_epoch * len(hist),
         "images_per_sec": round(rate, 1),
@@ -193,6 +223,9 @@ def main():
                 "cache_hit_rate": round(
                     h.get("train_cache_hit_rate", 0.0), 4
                 ),
+                "bytes_copied_per_batch": round(
+                    h.get("train_bytes_copied_per_batch", 0.0), 1
+                ),
             }
             for h in hist
         ],
@@ -201,7 +234,8 @@ def main():
         json.dump(out, f, indent=1)
     print(json.dumps({k: out[k] for k in (
         "images_per_sec", "starvation", "data_time_s", "batch_time_s",
-        "cache_hit_rate", "workers_mode", "host_cpu_count",
+        "cache_hit_rate", "cache_scope", "leased",
+        "bytes_copied_per_batch", "workers_mode", "host_cpu_count",
         "steps_total")}))
     print(f"wrote {args.out}")
     return 0

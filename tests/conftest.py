@@ -49,6 +49,47 @@ def pytest_collection_modifyitems(config, items):
         )
 
 
+@pytest.fixture(scope="session", autouse=True)
+def dptpu_shm_leak_guard():
+    """CI gate on /dev/shm hygiene: every dptpu segment (batch-slot ring
+    ``dptpu_ring_*``, pooled decode-cache slab ``dptpu_cache_*``) that
+    appears during the suite must be gone — or still owned by a live,
+    registered object whose atexit hook will unlink it — by session end.
+    A segment that is neither was abandoned without ``close()`` and
+    would leak host RAM until reboot in production."""
+    import glob
+
+    if not os.path.isdir("/dev/shm"):
+        yield  # platform without a tmpfs view; nothing to police
+        return
+    # segment names embed their CREATOR pid (dptpu_{kind}_{pid}_{hex});
+    # only this process creates segments for this suite (workers merely
+    # attach), so scoping to our pid keeps concurrent dptpu runs on the
+    # same host from tripping the guard
+    mine = (f"/dev/shm/dptpu_ring_{os.getpid()}_*",
+            f"/dev/shm/dptpu_cache_{os.getpid()}_*")
+    snapshot = lambda: {p for pat in mine for p in glob.glob(pat)}  # noqa: E731
+    before = snapshot()
+    yield
+    import gc
+
+    gc.collect()  # run __del__ for dropped loaders/datasets first
+    from dptpu.data import shm as _shm
+    from dptpu.data import shm_cache as _shm_cache
+
+    live = {
+        "/dev/shm/" + n.lstrip("/")
+        for n in (_shm.live_segment_names()
+                  | _shm_cache.live_segment_names())
+    }
+    leaked = snapshot() - before - live
+    assert not leaked, (
+        f"leaked /dev/shm segments (created during the suite, not "
+        f"closed, not owned by any live pipeline/cache): "
+        f"{sorted(leaked)}"
+    )
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devices = jax.devices()

@@ -34,16 +34,20 @@ def test_axis_junk_raises(monkeypatch):
 
 
 def test_feed_knobs_defaults_and_validation(monkeypatch):
-    monkeypatch.delenv("DPTPU_WORKERS_MODE", raising=False)
-    monkeypatch.delenv("DPTPU_CACHE_BYTES", raising=False)
-    assert _feed_knobs() == ("thread", 0)
+    for k in ("DPTPU_WORKERS_MODE", "DPTPU_CACHE_BYTES",
+              "DPTPU_CACHE_SCOPE", "DPTPU_LEASE"):
+        monkeypatch.delenv(k, raising=False)
+    # thread mode defaults: in-process cache is already pooled, so the
+    # scope default is the plain DecodeCache ("sharded")
+    assert _feed_knobs() == ("thread", 0, "sharded", True)
 
     monkeypatch.setenv("DPTPU_WORKERS_MODE", "process")
     monkeypatch.setenv("DPTPU_CACHE_BYTES", str(1 << 20))
-    assert _feed_knobs() == ("process", 1 << 20)
+    # process mode defaults to the pooled cross-process slab
+    assert _feed_knobs() == ("process", 1 << 20, "pooled", True)
 
     monkeypatch.setenv("DPTPU_CACHE_BYTES", "0")  # explicit off is valid
-    assert _feed_knobs() == ("process", 0)
+    assert _feed_knobs() == ("process", 0, "pooled", True)
 
     monkeypatch.setenv("DPTPU_WORKERS_MODE", "gevent")
     with pytest.raises(ValueError, match="DPTPU_WORKERS_MODE"):
@@ -57,3 +61,47 @@ def test_feed_knobs_defaults_and_validation(monkeypatch):
     monkeypatch.setenv("DPTPU_CACHE_BYTES", "lots")
     with pytest.raises(ValueError, match="not an integer"):
         _feed_knobs()
+
+
+def test_cache_scope_and_lease_knobs(monkeypatch):
+    monkeypatch.setenv("DPTPU_WORKERS_MODE", "process")
+    monkeypatch.delenv("DPTPU_CACHE_BYTES", raising=False)
+
+    monkeypatch.setenv("DPTPU_CACHE_SCOPE", "sharded")  # explicit override
+    monkeypatch.setenv("DPTPU_LEASE", "0")
+    assert _feed_knobs() == ("process", 0, "sharded", False)
+
+    monkeypatch.setenv("DPTPU_CACHE_SCOPE", "pooled")
+    monkeypatch.setenv("DPTPU_LEASE", "true")
+    assert _feed_knobs() == ("process", 0, "pooled", True)
+
+    monkeypatch.setenv("DPTPU_CACHE_SCOPE", "global")
+    with pytest.raises(ValueError, match="DPTPU_CACHE_SCOPE"):
+        _feed_knobs()
+
+    monkeypatch.setenv("DPTPU_CACHE_SCOPE", "pooled")
+    monkeypatch.setenv("DPTPU_LEASE", "maybe")
+    with pytest.raises(ValueError, match="DPTPU_LEASE"):
+        _feed_knobs()
+
+
+def test_lease_depth_knob_validated():
+    from dptpu.data import DataLoader, SyntheticDataset
+
+    with pytest.raises(ValueError, match="DPTPU_LEASE_DEPTH"):
+        DataLoader(SyntheticDataset(8, 8, 4), 4, lease_depth=0)
+
+
+def test_env_bool_and_choice_contract(monkeypatch):
+    from dptpu.envknob import env_bool, env_choice
+
+    monkeypatch.delenv("DPTPU_X", raising=False)
+    assert env_bool("DPTPU_X", True) is True
+    assert env_choice("DPTPU_X", ("a", "b"), "a") == "a"
+    monkeypatch.setenv("DPTPU_X", "off")
+    assert env_bool("DPTPU_X") is False
+    monkeypatch.setenv("DPTPU_X", "flase")
+    with pytest.raises(ValueError, match="DPTPU_X"):
+        env_bool("DPTPU_X")
+    with pytest.raises(ValueError, match="DPTPU_X"):
+        env_choice("DPTPU_X", ("a", "b"))

@@ -238,3 +238,93 @@ def test_preemption_guard_second_signal_aborts():
         assert guard.requested
         with pytest.raises(KeyboardInterrupt):
             guard._handler(signal.SIGTERM, None)
+
+
+# -- async checkpoint writer -------------------------------------------------
+
+def test_async_saves_land_identical_in_order_and_rotate(tmp_path):
+    """Cadence saves through the writer thread must produce the same
+    verifiable files a synchronous manager writes, in submission order,
+    with rotation applied."""
+    from dptpu.train.checkpoint import AsyncCheckpointWriter
+
+    w = AsyncCheckpointWriter()
+    manager = CheckpointManager(directory=str(tmp_path), keep=2,
+                                batch_size=4, async_writer=w)
+    paths = [
+        manager.save_step(tiny_state(float(s)), epoch=0, step_in_epoch=s)
+        for s in (1, 2, 3)
+    ]
+    w.flush()
+    # rotation kept the newest two; every survivor verifies and carries
+    # its exact resume coordinates
+    assert not os.path.exists(paths[0])
+    for s, p in zip((2, 3), paths[1:]):
+        ok, reason = verify_checkpoint(p)
+        assert ok, reason
+        restored, meta = load_checkpoint(p, tiny_state())
+        assert meta["step_in_epoch"] == s
+        assert meta["data_position"] == s * 4
+        np.testing.assert_array_equal(
+            restored.params["dense"]["kernel"],
+            tiny_state(float(s)).params["dense"]["kernel"],
+        )
+    w.close()
+
+
+def test_sync_save_drains_queue_first_so_newest_wins(tmp_path):
+    """A preemption/emergency save (sync=True) must flush queued async
+    saves before writing, so the newest-mtime file — what find_resumable
+    trusts — is the true latest position."""
+    from dptpu.train.checkpoint import AsyncCheckpointWriter
+
+    w = AsyncCheckpointWriter()
+    manager = CheckpointManager(directory=str(tmp_path), keep=3,
+                                async_writer=w)
+    manager.save_step(tiny_state(1.0), epoch=0, step_in_epoch=1)
+    final = manager.save_step(tiny_state(2.0), epoch=0, step_in_epoch=2,
+                              sync=True)
+    assert os.path.exists(final)  # durable the moment the call returns
+    assert find_resumable(str(tmp_path), verbose=False) == final
+    w.close()
+
+
+def test_async_write_error_surfaces_on_next_call(tmp_path):
+    """A failed background write must fail the run loudly on the next
+    checkpoint call — never vanish."""
+    from dptpu.train.checkpoint import AsyncCheckpointWriter
+
+    w = AsyncCheckpointWriter()
+    # a write closure that raises — the manager enqueues through the
+    # identical submit path
+    w.submit(lambda: (_ for _ in ()).throw(OSError("disk on fire")))
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.flush()
+    # the writer recovers: later saves work again
+    ok_dir = tmp_path / "ok"
+    manager2 = CheckpointManager(directory=str(ok_dir), keep=2,
+                                 async_writer=w)
+    p = manager2.save_step(tiny_state(), epoch=0, step_in_epoch=1)
+    w.flush()
+    assert os.path.exists(p)
+    w.close()
+
+
+def test_ckpt_truncate_fault_counts_async_writes_in_order(tmp_path):
+    """The ckpt_truncate@save=N fault hook rides the writer thread, so
+    'the N-th checkpoint written' keeps meaning write order under async
+    saves."""
+    from dptpu.train.checkpoint import AsyncCheckpointWriter
+
+    plan = FaultPlan("ckpt_truncate@save=2")
+    w = AsyncCheckpointWriter()
+    manager = CheckpointManager(directory=str(tmp_path), keep=3,
+                                fault_plan=plan, async_writer=w)
+    p1 = manager.save_step(tiny_state(1.0), epoch=0, step_in_epoch=1)
+    p2 = manager.save_step(tiny_state(2.0), epoch=0, step_in_epoch=2)
+    w.flush()
+    ok1, _ = verify_checkpoint(p1)
+    ok2, reason2 = verify_checkpoint(p2)
+    assert ok1
+    assert not ok2, "save #2 should have been torn by the fault"
+    w.close()

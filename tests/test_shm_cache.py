@@ -1,0 +1,240 @@
+"""Pooled cross-process decode cache (dptpu/data/shm_cache.py).
+
+The contract under test: one /dev/shm slab pools the whole
+``DPTPU_CACHE_BYTES`` budget across every worker process — any worker
+hits any cached image, hit ≡ miss bit-identical, byte budget respected
+with oldest-first eviction, oversized entries rejected, and the slab
+SURVIVES a supervisor pool restart warm (it belongs to the parent's
+dataset, not to the workers). Pooled, sharded and cache-off loaders must
+all yield the same bytes for the same ``(seed, epoch, index)`` RNG.
+
+JPEG fixtures are 52×44 (< 48·8/7): the native scale picker then stays
+at full resolution, which makes cache-on/off comparisons bit-exact (see
+ImageFolderDataset docstring) — the same fixture discipline as
+tests/test_shm_loader.py.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dptpu.data import (
+    DataLoader,
+    ImageFolderDataset,
+    ShmDecodeCache,
+    train_transform,
+)
+
+
+@pytest.fixture(scope="module")
+def jpeg_folder(tmp_path_factory):
+    root = tmp_path_factory.mktemp("shmcachejpeg")
+    rng = np.random.RandomState(7)
+    for cls in ["c0", "c1"]:
+        d = root / cls
+        d.mkdir()
+        for i in range(9):
+            low = rng.randint(0, 255, (8, 7, 3), np.uint8)
+            img = Image.fromarray(low).resize((52, 44), Image.BILINEAR)
+            img.save(str(d / f"{i}.jpg"), quality=85)
+    return str(root)
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x["images"], y["images"])
+        np.testing.assert_array_equal(x["labels"], y["labels"])
+        assert ("mask" in x) == ("mask" in y)
+        if "mask" in x:
+            np.testing.assert_array_equal(x["mask"], y["mask"])
+
+
+# -- unit: slab semantics ---------------------------------------------------
+
+def test_roundtrip_and_budget_contract():
+    c = ShmDecodeCache(1 << 20)
+    try:
+        rng = np.random.RandomState(0)
+        arrs = {i: rng.randint(0, 256, (32, 40, 3), np.uint8)
+                for i in range(6)}
+        for i, a in arrs.items():
+            assert c.put(("k", i), a)
+        for i, a in arrs.items():
+            got = c.get(("k", i))
+            np.testing.assert_array_equal(got, a)
+        assert c.hits == 6 and len(c) == 6
+        assert c.bytes_in_use <= c.budget_bytes
+        # unknown key is a miss
+        assert c.get(("k", 99)) is None
+        assert c.misses == 1
+    finally:
+        c.close()
+
+
+def test_eviction_is_oldest_first_and_budget_holds():
+    c = ShmDecodeCache(512 << 10)
+    try:
+        rng = np.random.RandomState(1)
+        arrs = {i: rng.randint(0, 256, (64, 100, 3), np.uint8)
+                for i in range(40)}  # ~19 KB each, way past 512 KB total
+        for i, a in arrs.items():
+            assert c.put(("e", i), a)
+            assert c.bytes_in_use <= c.budget_bytes
+        assert c.evictions > 0
+        # the newest insert always survives; the oldest is gone
+        np.testing.assert_array_equal(c.get(("e", 39)), arrs[39])
+        assert c.get(("e", 0)) is None
+    finally:
+        c.close()
+
+
+def test_oversized_entry_rejected_not_cached():
+    c = ShmDecodeCache(256 << 10)
+    try:
+        big = np.zeros((300, 300, 3), np.uint8)  # 270 KB > 256 KB budget
+        assert not c.put("big", big)
+        assert len(c) == 0 and c.bytes_in_use == 0
+    finally:
+        c.close()
+
+
+def test_wraparound_preserves_survivor_bytes():
+    """Ring-arena stress: random-size inserts far past the budget; every
+    surviving entry must read back bit-exact (no torn regions across the
+    wrap seam)."""
+    c = ShmDecodeCache(1 << 20)
+    try:
+        rng = np.random.RandomState(2)
+        kept = {}
+        for i in range(300):
+            a = rng.randint(
+                0, 256,
+                (int(rng.randint(8, 90)), int(rng.randint(8, 90)), 3),
+                np.uint8,
+            )
+            if c.put(("w", i), a):
+                kept[i] = a
+        survivors = 0
+        for i, a in kept.items():
+            got = c.get(("w", i))
+            if got is None:
+                continue
+            np.testing.assert_array_equal(got, a)
+            survivors += 1
+        assert survivors > 0
+    finally:
+        c.close()
+
+
+def test_scale_budget_is_a_pooled_noop():
+    c = ShmDecodeCache(1 << 20)
+    try:
+        c.scale_budget(8)  # the worker-pool split call: must not shrink
+        assert c.budget_bytes == 1 << 20
+        with pytest.raises(ValueError):
+            c.scale_budget(0)
+    finally:
+        c.close()
+
+
+def test_close_unlinks_segment():
+    import os
+
+    c = ShmDecodeCache(1 << 20)
+    seg = "/dev/shm/" + c.segment_name.lstrip("/")
+    if not os.path.exists(seg):
+        c.close()
+        pytest.skip("/dev/shm not exposed as a filesystem here")
+    c.close()
+    assert not os.path.exists(seg)
+    c.close()  # double-close stays a no-op
+    assert c.get("anything") is None and not c.put("x", np.zeros(
+        (2, 2, 3), np.uint8))
+
+
+def test_stats_shape_matches_decode_cache():
+    c = ShmDecodeCache(1 << 20)
+    try:
+        s = c.stats()
+        for k in ("cache_hits", "cache_misses", "cache_evictions",
+                  "cache_entries", "cache_bytes_in_use",
+                  "cache_budget_bytes", "cache_hit_rate"):
+            assert k in s
+        assert s["cache_scope"] == "pooled"
+    finally:
+        c.close()
+
+
+# -- integration: pooled vs sharded vs thread, bit for bit ------------------
+
+def test_pooled_cache_bit_identical_across_modes_and_reshuffles(jpeg_folder):
+    """The acceptance bar: pooled-slab process loader ≡ sharded process
+    loader ≡ thread loader, across epochs (each epoch is a fresh
+    reshuffle), with the pooled cache actually getting hits."""
+    mk = lambda scope: ImageFolderDataset(  # noqa: E731
+        jpeg_folder, train_transform(48), cache_bytes=32 << 20,
+        cache_scope=scope,
+    )
+    th = DataLoader(mk("sharded"), 4, num_workers=2, seed=5)
+    sh = DataLoader(mk("sharded"), 4, num_workers=2, seed=5,
+                    workers_mode="process")
+    po = DataLoader(mk("pooled"), 4, num_workers=2, seed=5,
+                    workers_mode="process")
+    try:
+        for epoch in (0, 1, 2):
+            a = list(th.epoch(epoch))
+            _assert_batches_equal(a, list(sh.epoch(epoch)))
+            _assert_batches_equal(a, list(po.epoch(epoch)))
+        fs = po.feed_stats()
+        assert fs["cache_scope"] == "pooled"
+        assert fs["cache_hits"] > 0
+        assert 0.0 < fs["cache_hit_rate"] <= 1.0
+    finally:
+        th.close()
+        sh.close()
+        po.close()
+
+
+def test_pooled_slab_survives_pool_restart_warm(jpeg_folder):
+    """Kill a worker mid-epoch: the supervisor restarts the pool, the
+    slab (owned by the parent's dataset) keeps its entries, and the
+    epoch completes bit-identical to thread mode."""
+    ds = ImageFolderDataset(jpeg_folder, train_transform(48),
+                            cache_bytes=32 << 20, cache_scope="pooled")
+    th = DataLoader(
+        ImageFolderDataset(jpeg_folder, train_transform(48)),
+        4, num_workers=2, seed=5,
+    )
+    pr = DataLoader(ds, 4, num_workers=2, seed=5, workers_mode="process")
+    try:
+        warm_entries_before = None
+        ref = list(th.epoch(0))
+        _ = list(pr.epoch(0))  # epoch 0 fills the slab
+        pr.feed_stats()  # set the interval baseline at the epoch edge
+        warm_entries_before = len(ds.decode_cache)
+        assert warm_entries_before > 0
+        it = pr.epoch(1)
+        got = [next(it)]
+        assert pr.kill_one_worker() is not None
+        got += list(it)
+        _assert_batches_equal(list(th.epoch(1)), got)
+        fs = pr.feed_stats()
+        assert fs["pool_restarts"] >= 1
+        # the restart did NOT cold-start the cache: the slab still holds
+        # (at least) the pre-kill working set
+        assert len(ds.decode_cache) >= warm_entries_before
+        # ... and the respawned pool's counter reset didn't corrupt the
+        # interval hit rate (counters fold into a monotonic base): the
+        # post-kill epoch ran warm off the surviving slab
+        assert fs["cache_hit_rate"] > 0.5
+        assert pr.workers_mode == "process"
+    finally:
+        th.close()
+        pr.close()
+
+
+def test_invalid_cache_scope_rejected(jpeg_folder):
+    with pytest.raises(ValueError, match="cache_scope"):
+        ImageFolderDataset(jpeg_folder, train_transform(48),
+                           cache_bytes=1 << 20, cache_scope="global")
