@@ -43,6 +43,17 @@ imagenet_ddp_apex.py:26-39,304-351), rebuilt for the TPU host model:
   ``leased=False`` copy path: a leased batch's bytes are only stable
   until the iterator advances past it (the after-yield backstop then
   reclaims the slot).
+* DECODE-AHEAD PIPELINED FEED (process mode): the ring depth is its
+  own knob (``DPTPU_RING_DEPTH``, decoupled from prefetch and lease
+  depth) and a pre-issue pump keeps spans for up to
+  ``DPTPU_DECODE_AHEAD`` batches queued on the workers the moment
+  slots free — workers never drain at batch boundaries, a straggler
+  span delays only its own batch's collect (and ``DPTPU_SPECULATE``
+  re-issues it to an idle worker after ``speculate_after_s``), and the
+  pre-issue moment doubles as the cold-epoch JPEG readahead hook
+  (``DPTPU_READAHEAD`` — ``posix_fadvise(WILLNEED)`` so worker reads
+  land in a warm page cache). All of it preserves the bit-identity,
+  lease and restart/resume contracts (dptpu/data/shm.py docstring).
 """
 
 from __future__ import annotations
@@ -75,7 +86,12 @@ class DataLoader:
                  pad_final: bool = True, seed: int = 0,
                  workers_mode: str = "thread", mp_start: str = "spawn",
                  leased: bool = False, lease_depth: Optional[int] = None,
-                 span_affinity: Optional[bool] = None):
+                 span_affinity: Optional[bool] = None,
+                 ring_depth: Optional[int] = None,
+                 decode_ahead: Optional[int] = None,
+                 speculate: Optional[bool] = None,
+                 speculate_after_s: float = 0.5,
+                 readahead: Optional[bool] = None):
         from dptpu.envknob import env_bool, env_int
 
         if workers_mode not in ("thread", "process"):
@@ -108,6 +124,41 @@ class DataLoader:
             span_affinity if span_affinity is not None
             else env_bool("DPTPU_SPAN_AFFINITY", True)
         )
+        # decode-ahead pipelining knobs (process mode; locked fail-fast
+        # contract — every explicit-but-invalid value raises):
+        # * ring_depth — TOTAL batch slots in the shared-memory ring;
+        #   None derives it from the issue window + lease depth;
+        # * decode_ahead — batches whose spans may be pre-issued ahead
+        #   of the consume point. Explicit values are EXACT (=1 is the
+        #   batch-serial baseline the benches A/B against); None keeps
+        #   at least the legacy prefetch window, deepened to >= 4.
+        self.ring_depth = (
+            ring_depth if ring_depth is not None
+            else env_int("DPTPU_RING_DEPTH", None)
+        )
+        if self.ring_depth is not None and self.ring_depth < 2:
+            raise ValueError(
+                f"DPTPU_RING_DEPTH={self.ring_depth} must be >= 2 batch "
+                f"slots (one collecting + one in flight)"
+            )
+        self.decode_ahead = (
+            decode_ahead if decode_ahead is not None
+            else env_int("DPTPU_DECODE_AHEAD", None)
+        )
+        if self.decode_ahead is not None and self.decode_ahead < 1:
+            raise ValueError(
+                f"DPTPU_DECODE_AHEAD={self.decode_ahead} must be >= 1 "
+                f"batch in flight (1 = batch-serial issue, no lookahead)"
+            )
+        self.speculate = (
+            speculate if speculate is not None
+            else env_bool("DPTPU_SPECULATE", True)
+        )
+        self.speculate_after_s = speculate_after_s
+        self.readahead = (
+            readahead if readahead is not None
+            else env_bool("DPTPU_READAHEAD", True)
+        )
         self._get = getattr(dataset, "get", None)
         self._get_into = getattr(dataset, "get_into", None)
         self._item_shape = None  # probed from the first sample
@@ -117,6 +168,13 @@ class DataLoader:
         self._degraded = False  # process pool gave up → thread fallback
         self._supervision = {"pool_restarts": 0, "span_retries": 0}
         self._copy_totals = {"bytes_copied": 0, "collects": 0}
+        # ring telemetry folded across pipeline rebuilds (same
+        # survive-rebuilds discipline as _supervision/_copy_totals)
+        self._ring_totals = {"occupancy_sum": 0, "occupancy_samples": 0,
+                             "io_wait_s": 0.0, "straggler_reissues": 0}
+        self._prev_io_wait = 0.0  # feed_stats interval baseline
+        self._issue_ahead_sum = 0  # pre-issued batches, sampled per batch
+        self._issue_ahead_n = 0
         self._pool = (
             ThreadPoolExecutor(
                 max_workers=self.num_workers, thread_name_prefix="dptpu-data"
@@ -266,17 +324,20 @@ class DataLoader:
 
     def _epoch_process(self, chunks, epoch, ahead):
         """Process-mode epoch: drive the shared-memory slot ring
-        (dptpu/data/shm.py) with the same submit-ahead/collect-in-order
-        cadence as the thread path. ``leased=True`` yields zero-copy slot
-        views carrying a ``"_lease"`` token; an after-yield backstop
-        reclaims any lease the consumer didn't release, so the ring keeps
-        flowing even for consumers unaware of the protocol (their batch
-        bytes are then only stable until they advance — retaining
-        consumers must use the copy path). If the supervised pool
-        exhausts its restart budget (``WorkerPoolBroken``), degrade to
-        thread mode for the rest of the run instead of killing the job —
-        batches are bit-identical between modes, so the hand-off is
-        seamless."""
+        (dptpu/data/shm.py) as a DECODE-AHEAD pipeline — a pump keeps up
+        to ``issue window`` batches' spans pre-issued into the per-worker
+        queues, refilling the moment slots free, so workers roll straight
+        across batch boundaries while ``collect`` consumes in batch
+        order (spans complete out of order against per-slot counters).
+        ``leased=True`` yields zero-copy slot views carrying a
+        ``"_lease"`` token; an after-yield backstop reclaims any lease
+        the consumer didn't release, so the ring keeps flowing even for
+        consumers unaware of the protocol (their batch bytes are then
+        only stable until they advance — retaining consumers must use
+        the copy path). If the supervised pool exhausts its restart
+        budget (``WorkerPoolBroken``), degrade to thread mode for the
+        rest of the run instead of killing the job — batches are
+        bit-identical between modes, so the hand-off is seamless."""
         from dptpu.data.shm import WorkerPoolBroken
 
         if not chunks:
@@ -285,18 +346,50 @@ class DataLoader:
         nb = len(chunks)
         b = 0
         try:
-            slots = ahead + 1 + (self.lease_depth if self.leased else 0)
+            # issue window: explicit decode_ahead is exact (=1 is the
+            # batch-serial baseline); default keeps at least the legacy
+            # prefetch window, deepened to 4 for multi-batch lookahead
+            window = (
+                self.decode_ahead if self.decode_ahead is not None
+                else max(ahead, 4)
+            )
+            slots = (
+                self.ring_depth if self.ring_depth is not None
+                else window + 1 + (self.lease_depth if self.leased else 0)
+            )
             pipe = self._ensure_pipeline(slots=slots)
             pipe.reset()  # reclaim slots from an abandoned prior epoch
             pending = deque()
-            for chunk, _ in chunks[:ahead]:
-                pending.append(pipe.submit(chunk, epoch))
-            next_idx = ahead
+            next_idx = 0
             for b in range(nb):
+                # the pre-issue pump: fill every free slot up to the
+                # issue window before blocking on the in-order collect
+                while True:
+                    while next_idx < nb and len(pending) < window \
+                            and pipe.free_slot_count() > 0:
+                        pending.append(
+                            pipe.submit(chunks[next_idx][0], epoch))
+                        next_idx += 1
+                    if pending:
+                        break
+                    if pipe.ghost_issues_in_flight():
+                        # every free slot is ghost-quarantined: the
+                        # pending ghost acks (or a watchdog restart)
+                        # will free one — drain instead of raising on
+                        # a ring that is merely small
+                        pipe.drain_one_ack()
+                        continue
+                    # only unreleased LEASES can still be holding the
+                    # ring: those the consumer must release
+                    raise RuntimeError(
+                        f"decode-ahead ring stalled: all "
+                        f"{pipe.slots} slots are held by unreleased "
+                        f"leases with no batch in flight — release "
+                        f"leases promptly or raise DPTPU_RING_DEPTH"
+                    )
+                self._issue_ahead_sum += len(pending)
+                self._issue_ahead_n += 1
                 slot, n_valid = pending.popleft()
-                if next_idx < nb:
-                    pending.append(pipe.submit(chunks[next_idx][0], epoch))
-                    next_idx += 1
                 out_size = self.batch_size if self.pad_final else n_valid
                 imgs, labels, lease = pipe.collect(
                     slot, out_size, leased=self.leased
@@ -305,25 +398,44 @@ class DataLoader:
                                        valid=chunks[b][1])
                 if lease is not None:
                     batch["_lease"] = lease
-                yield batch
-                if lease is not None:
-                    # backstop: the consumer moved on without releasing
-                    # (no-op when DevicePrefetcher already did)
-                    lease.release()
+                try:
+                    yield batch
+                finally:
+                    if lease is not None:
+                        # backstop: the consumer moved on (or abandoned
+                        # the epoch — GeneratorExit lands here too)
+                        # without releasing; no-op when DevicePrefetcher
+                        # already did
+                        lease.release()
         except WorkerPoolBroken as e:
             self._degrade_to_thread(str(e))
             # batch b was never yielded; re-decode from it on threads
+            # (pre-issued batches beyond b die with the pool — the
+            # thread path re-earns them)
             yield from self._epoch_thread(chunks[b:], epoch, ahead)
 
-    def _retire_pipeline(self):
+    def _retire_pipeline(self, forgive_leases: bool = False):
         """Close the pipeline, folding its supervision counters into the
         loader's base first — feed_stats' survive-rebuilds invariant has
-        exactly one implementation."""
+        exactly one implementation.
+
+        ``forgive_leases``: a loader-initiated retirement (ring-depth
+        rebuild between epochs, degrade-to-thread) REVOKES any lease
+        carried over from an abandoned epoch — the consumer's late
+        ``release()`` voids against the closed pipeline — instead of
+        reporting it as a protocol leak; only ``close()`` (the consumer
+        said it was done) treats an unreleased lease as a bug for the
+        conftest leak guard to fail on."""
         if self._pipeline is not None:
+            if forgive_leases:
+                self._pipeline._leased.clear()
             for k, v in self._pipeline.supervision_stats().items():
                 self._supervision[k] += v
             for k, v in self._pipeline.copy_stats().items():
                 self._copy_totals[k] += v
+            for k, v in self._pipeline.ring_stats().items():
+                if k in self._ring_totals:
+                    self._ring_totals[k] += v
             self._pipeline.close()
             self._pipeline = None
 
@@ -337,7 +449,7 @@ class DataLoader:
             f"thread mode (slower, but alive): {reason}",
             file=sys.stderr,
         )
-        self._retire_pipeline()
+        self._retire_pipeline(forgive_leases=True)
         self.workers_mode = "thread"
         self._degraded = True
         if self._pool is None:
@@ -355,14 +467,25 @@ class DataLoader:
     def _ensure_pipeline(self, slots: int):
         from dptpu.data.shm import ShmBatchPipeline
 
-        if self._pipeline is not None and self._pipeline.slots < slots:
-            # prefetch depth grew between epochs: rebuild the ring
-            self._retire_pipeline()
+        if self._pipeline is not None and self._pipeline.slots != slots:
+            # ring depth changed between epochs — GREW (deeper prefetch/
+            # decode-ahead: the old ring cannot hold the window) or
+            # SHRANK (a smaller window would silently pin the surplus
+            # slots' memory forever): rebuild either way. Leased slots
+            # carried over from the old ring are safe: retire closes the
+            # pipeline, so a consumer's late release() voids against the
+            # closed/generation check instead of touching the new ring,
+            # and close_segment unlinks the segment NAME even while the
+            # stale views keep their mapping alive.
+            self._retire_pipeline(forgive_leases=True)
         if self._pipeline is None:
             self._pipeline = ShmBatchPipeline(
                 self.dataset, self.batch_size, self._item_shape,
                 num_workers=self.num_workers, seed=self.seed, slots=slots,
                 mp_start=self.mp_start, span_affinity=self.span_affinity,
+                speculate=self.speculate,
+                speculate_after_s=self.speculate_after_s,
+                readahead=self.readahead,
             )
             # fresh workers count from zero: re-baseline the interval
             # hit-rate bookkeeping in feed_stats
@@ -397,16 +520,36 @@ class DataLoader:
             stats["leased"] = self.leased
             stats["span_affinity"] = self.span_affinity
             copied = dict(self._copy_totals)
+            ring = dict(self._ring_totals)
             if self._pipeline is not None:
                 stats.update(self._pipeline.cache_stats())
                 for k, v in self._pipeline.copy_stats().items():
                     copied[k] += v
+                pipe_ring = self._pipeline.ring_stats()
+                for k in ring:
+                    ring[k] += pipe_ring[k]
+                stats["ring_depth"] = pipe_ring["ring_depth"]
             # the zero-copy contract, measured: parent-side copy-out
             # bytes per collected batch (0 when every collect was leased)
             stats["bytes_copied_per_batch"] = (
                 copied["bytes_copied"] / copied["collects"]
                 if copied["collects"] else 0.0
             )
+            # decode-ahead telemetry: mean in-flight slots at collect
+            # time, mean pre-issued batches, speculative re-issues, and
+            # the INTERVAL parent-blocked-on-spans time (per-epoch when
+            # feed_stats is called once per epoch, like the train loop)
+            stats["ring_occupancy"] = (
+                ring["occupancy_sum"] / ring["occupancy_samples"]
+                if ring["occupancy_samples"] else 0.0
+            )
+            stats["issue_ahead_depth"] = (
+                self._issue_ahead_sum / self._issue_ahead_n
+                if self._issue_ahead_n else 0.0
+            )
+            stats["straggler_reissues"] = ring["straggler_reissues"]
+            stats["io_wait_s"] = ring["io_wait_s"] - self._prev_io_wait
+            self._prev_io_wait = ring["io_wait_s"]
         else:
             cache = getattr(self.dataset, "decode_cache", None)
             if cache is not None:

@@ -20,6 +20,32 @@ def available() -> bool:
     return load_library() is not None
 
 
+def file_readahead(path: str) -> bool:
+    """Advise the kernel to pull ``path``'s bytes into the page cache
+    (``posix_fadvise(WILLNEED)``) — the decode-ahead pipeline's
+    cold-epoch byte prefetch, issued by the PARENT when a span is
+    pre-issued so the worker's read (``decode_ahead`` batches later)
+    services from memory. The native call releases the GIL; without the
+    native lib, ``os.posix_fadvise`` covers Linux. Returns True when
+    advice was delivered (best-effort — False never blocks decode)."""
+    lib = load_library()
+    if lib is not None:
+        return lib.dptpu_file_readahead(path.encode()) >= 0
+    import os
+
+    if not hasattr(os, "posix_fadvise"):
+        return False
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_WILLNEED)
+        finally:
+            os.close(fd)
+        return True
+    except OSError:
+        return False
+
+
 def jpeg_dims(data: bytes) -> Optional[Tuple[int, int]]:
     """(width, height) from the JPEG header, or None if not decodable."""
     lib = load_library()

@@ -35,7 +35,32 @@ loader's preallocated batch memory itself:
   after the device transfer of that batch completes), eliminating the
   parent's per-batch copy-out entirely — ``feed_stats`` reports
   ``bytes_copied_per_batch = 0``. The legacy copy-out path remains the
-  default for consumers that retain batches (``leased=False``).
+  default for consumers that retain batches (``leased=False``);
+* DECODE-AHEAD PIPELINING: the ring depth is decoupled from the lease
+  depth (``DPTPU_RING_DEPTH``) and the DataLoader pre-issues spans for
+  up to ``DPTPU_DECODE_AHEAD`` batches the moment slots free, so the
+  per-worker queues always hold the NEXT batches' spans — workers roll
+  straight across batch boundaries instead of draining while the
+  parent collects, and per-slot completion counters absorb spans
+  finishing out of batch order. ``collect`` still consumes in batch
+  order (the epoch contract is unchanged);
+* SPECULATIVE STRAGGLER RE-ISSUE (``DPTPU_SPECULATE``, default on):
+  when a collect has waited ``speculate_after_s`` on a slot whose last
+  spans sit on a stalled worker, the parent re-issues those spans to
+  IDLE workers. First-writer-wins is safe under the ``(seed, epoch,
+  index)`` bit-identity contract — both copies write the SAME bytes
+  into the SAME disjoint rows, so even racing writes cannot tear. The
+  late twin's ack is recognized as a GHOST (its task is no longer
+  pending) and, until it arrives, the slot is QUARANTINED rather than
+  recycled: a ghost still writing its (old, identical) bytes must
+  never overlap a NEW batch decoded into a reused slot;
+* COLD-EPOCH BYTE READAHEAD (``DPTPU_READAHEAD``, default on): at span
+  pre-issue time the parent advises the kernel
+  (``posix_fadvise(WILLNEED)`` via the native ``dptpu_file_readahead``
+  or the ``os`` fallback) to start pulling the JPEG bytes of the
+  pre-issued batches into the page cache — the workers' reads land
+  warm ``DPTPU_DECODE_AHEAD`` batches later, hiding cold-epoch I/O
+  latency under decode of the current batches.
 
 SUPERVISION (dptpu.resilience): the pool is watched, not trusted. Every
 result wait runs under a deadline (``DPTPU_WORKER_TIMEOUT_S``); a dead
@@ -82,6 +107,19 @@ SEGMENT_PREFIX = "dptpu_ring"
 
 _LIVE_PIPELINES: "weakref.WeakSet" = weakref.WeakSet()
 _ATEXIT_REGISTERED = False
+
+# slots still leased (never released by the consumer, never revoked by a
+# reset) when their pipeline closed — a consumer-side protocol bug; the
+# conftest session fixture fails the suite when this moves
+_LEASE_LEAKS = 0
+
+
+def leaked_lease_count() -> int:
+    """Slots that were still leased when their pipeline closed, summed
+    over every pipeline this process has closed. A lease the consumer
+    released (or a ``reset`` revoked — the abandoned-epoch path) never
+    counts; only close-with-lease-outstanding does."""
+    return _LEASE_LEAKS
 
 
 def _atexit_close_all():
@@ -291,7 +329,10 @@ class ShmBatchPipeline:
                  timeout_s: Optional[float] = None,
                  max_restarts: Optional[int] = None,
                  span_retries: Optional[int] = None,
-                 span_affinity: bool = True):
+                 span_affinity: bool = True,
+                 speculate: bool = True,
+                 speculate_after_s: float = 0.5,
+                 readahead: bool = True):
         import multiprocessing as mp
 
         self.batch_size = batch_size
@@ -353,6 +394,29 @@ class ShmBatchPipeline:
         self._consec_failures = 0
         self._bytes_copied = 0  # parent-side copy-out bytes (legacy path)
         self._collects = 0
+        # decode-ahead / speculation bookkeeping
+        self.speculate = speculate and self.num_workers > 1
+        self.speculate_after_s = speculate_after_s
+        self._worker_load = [0] * self.num_workers  # unacked issues per q
+        self._extra_issues = [0] * self.slots  # unacked DUPLICATE issues
+        self._quarantine = set()  # freed slots awaiting ghost acks
+        self._speculated = set()  # (slot, task_id) already re-issued
+        self._straggler_reissues_total = 0
+        self._io_wait_s = 0.0  # parent time blocked in collect waits
+        self._occ_sum = 0  # ring-occupancy accumulator (sampled at collect)
+        self._occ_n = 0
+        # cold-epoch byte readahead: fadvise the pre-issued spans' JPEG
+        # files so worker reads land in a warm page cache (file-backed
+        # datasets only — synthetic ones have no paths to advise).
+        # Advised-once dedup is a per-index BITMAP, not a set of path
+        # strings: at ImageNet scale the strings would pin hundreds of
+        # MB of parent RSS for the pipeline's lifetime
+        self._readahead = readahead
+        self._sample_paths = getattr(dataset, "samples", None)
+        self._readahead_done = (
+            bytearray(len(self._sample_paths))
+            if self._sample_paths is not None else None
+        )
         self._closed = False
         self._start_workers()
         _register_pipeline(self)
@@ -361,6 +425,9 @@ class ShmBatchPipeline:
         """(Re)create the task/ack queues and spawn the worker pool —
         queues are rebuilt with the pool because a SIGKILLed worker can
         leave a queue's internal pipe in a torn state."""
+        # straggler detection baseline: a worker is SUSPECT once it has
+        # gone speculate_after_s without acking (reset with the pool)
+        self._worker_last_ack = [time.monotonic()] * self.num_workers
         self._task_qs = [self._ctx.Queue() for _ in range(self.num_workers)]
         self._res_q = self._ctx.Queue()
         self._procs = [
@@ -380,18 +447,42 @@ class ShmBatchPipeline:
 
     # -- submission / collection -------------------------------------------
 
+    def free_slot_count(self) -> int:
+        """Slots available to ``submit`` right now (the DataLoader's
+        pre-issue pump gates on this instead of racing the exception)."""
+        return len(self._free)
+
+    def ghost_issues_in_flight(self) -> bool:
+        """True while any speculated duplicate issue is still unacked —
+        quarantined slots can only re-enter the free ring once these
+        drain (or a pool restart vaporizes them)."""
+        return any(self._extra_issues)
+
+    def drain_one_ack(self):
+        """Process ONE worker ack under the watchdog — the pump's
+        escape hatch when every free slot is ghost-quarantined: the ack
+        (or the watchdog's restart) is what frees a slot."""
+        self._handle(self._next_result(), mode="normal")
+
     def submit(self, batch_indices, epoch: int) -> Tuple[int, int]:
         """Fan one batch out as affinity-routed span tasks into a free
-        slot; returns ``(slot, n_valid)``. The caller's prefetch depth
-        plus its unreleased leases must not exceed ``slots`` (DataLoader
-        sizes the ring accordingly)."""
+        slot; returns ``(slot, n_valid)``. The caller's issue-ahead
+        window plus its unreleased leases must not exceed ``slots``
+        (DataLoader sizes the ring accordingly)."""
         if not self._free:
             raise RuntimeError(
                 f"no free batch slot (ring of {self.slots}, "
-                f"{len(self._leased)} leased, rest in flight) — prefetch "
-                f"depth plus unreleased leases exceeded the ring size"
+                f"{len(self._leased)} leased, {len(self._quarantine)} "
+                f"ghost-quarantined, rest in flight) — issue-ahead depth "
+                f"plus unreleased leases exceeded the ring size"
             )
         slot = self._free.pop()
+        # drop the previous tenant's speculation records: (slot, task_id)
+        # pairs recur when slots are reused, and a stale entry would
+        # silently veto re-issue for the NEW batch's spans (safe to drop
+        # here — a slot re-enters the free ring only once its ghost
+        # issues have fully drained)
+        self._speculated = {k for k in self._speculated if k[0] != slot}
         spans = (
             _affinity_spans(batch_indices, self.num_workers)
             if self.span_affinity
@@ -401,8 +492,31 @@ class ShmBatchPipeline:
             task = (slot, task_id, offsets, idxs, epoch, wid)
             self._pending[slot][task_id] = task
             self._task_qs[wid].put(task[:5])
+            self._worker_load[wid] += 1
         self._outstanding[slot] = len(self._pending[slot])
+        if self._readahead:
+            self._issue_readahead(batch_indices)
         return slot, len(batch_indices)
+
+    def _issue_readahead(self, batch_indices):
+        """Parent-side cold-epoch byte prefetch: advise the kernel to
+        start reading this (pre-issued) batch's JPEG files NOW, so the
+        worker that decodes them ``decode_ahead`` batches from now finds
+        the bytes already in the page cache. Each path is advised once
+        per pipeline — after the first epoch the cache is as warm as it
+        will get and repeated advice is pure syscall overhead."""
+        samples = self._sample_paths
+        if samples is None:
+            return
+        from dptpu.data.native_image import file_readahead
+
+        done = self._readahead_done
+        for raw in batch_indices:
+            i = int(raw)
+            if done[i]:
+                continue
+            done[i] = 1
+            file_readahead(samples[i][0])
 
     def collect(self, slot: int, out_rows: int, leased: bool = False):
         """Wait for ``slot``'s spans, then hand the rows to the consumer:
@@ -410,9 +524,28 @@ class ShmBatchPipeline:
         recycles immediately); ``leased=True`` returns zero-copy VIEWS
         plus a :class:`SlotLease` — the slot recycles only on
         ``lease.release()``. Raises the worker's decode error, with its
-        traceback, once its retry budget is spent."""
+        traceback, once its retry budget is spent.
+
+        Acks are processed for WHATEVER slot they belong to while
+        waiting (out-of-order span completion); and once the wait has
+        lasted ``speculate_after_s``, the remaining spans of THIS slot
+        are re-issued to idle workers (straggler speculation)."""
+        t0 = time.monotonic()
+
+        def _tick():
+            # re-checked every poll (a no-op pass is a few comparisons):
+            # the first attempt may find no healthy target yet — e.g.
+            # every worker still busy or warming up — and a straggler is
+            # only recognizable once its peers pull ahead
+            if self.speculate and time.monotonic() - t0 \
+                    >= self.speculate_after_s:
+                self._speculate_slot(slot)
+
         while self._outstanding[slot] > 0:
-            self._handle(self._next_result(), mode="normal")
+            self._handle(self._next_result(tick=_tick), mode="normal")
+        self._io_wait_s += time.monotonic() - t0
+        self._occ_sum += self.slots - len(self._free)
+        self._occ_n += 1
         self._collects += 1
         if leased:
             self._leased.add(slot)
@@ -422,8 +555,68 @@ class ShmBatchPipeline:
         imgs = np.array(self._imgs[slot, :out_rows])
         labels = np.array(self._labels[slot, :out_rows])
         self._bytes_copied += imgs.nbytes + labels.nbytes
-        self._free.append(slot)
+        self._recycle_slot(slot)
         return imgs, labels, None
+
+    def _recycle_slot(self, slot: int):
+        """Return a consumed slot to the free ring — unless a speculated
+        ghost write may still be in flight for it, in which case it is
+        QUARANTINED until the ghost acks (``_ghost_ack``): the ghost's
+        bytes are identical to what the slot held, but would corrupt a
+        NEW batch decoded into the reused slot."""
+        if self._extra_issues[slot] > 0:
+            self._quarantine.add(slot)
+        else:
+            self._free.append(slot)
+
+    def _ghost_ack(self, slot: int):
+        """Account one DUPLICATE ack (speculated twin, or the late ack
+        of a span a retry/salvage already satisfied) and release the
+        slot from quarantine once no ghost writer remains."""
+        if self._extra_issues[slot] > 0:
+            self._extra_issues[slot] -= 1
+        if slot in self._quarantine and self._extra_issues[slot] == 0:
+            self._quarantine.discard(slot)
+            self._free.append(slot)
+
+    def _speculate_slot(self, slot: int):
+        """Re-issue ``slot``'s still-pending spans when their assigned
+        worker looks STALLED — no ack from it within the speculation
+        window — to the least-loaded HEALTHY worker (one duplicate per
+        span, ever). The assigned worker keeps its copy — whichever
+        finishes first completes the span (identical bytes, so even a
+        racing write is benign) and the loser's ack is absorbed as a
+        ghost. Healthy-target gating is what keeps this safe on a
+        uniformly slow cold batch: when every worker is busy-but-acking
+        there is no suspect, and when every worker is suspect there is
+        no target — either way no decode work is doubled."""
+        now = time.monotonic()
+        # suspect = OWES work and has not acked within the window; a
+        # worker with nothing queued is idle-HEALTHY (a drained queue
+        # also goes quiet, and it is exactly the re-issue target)
+        suspect = [
+            self._worker_load[w] > 0
+            and now - self._worker_last_ack[w] >= self.speculate_after_s
+            for w in range(self.num_workers)
+        ]
+        healthy = [w for w in range(self.num_workers) if not suspect[w]]
+        if not healthy:
+            return
+        for task_id, task in list(self._pending[slot].items()):
+            if (slot, task_id) in self._speculated:
+                continue
+            assigned = task[5]
+            if not suspect[assigned]:
+                continue  # its worker is alive and acking: just slow us
+            targets = [w for w in healthy if w != assigned]
+            if not targets:
+                continue
+            w = min(targets, key=lambda k: self._worker_load[k])
+            self._speculated.add((slot, task_id))
+            self._extra_issues[slot] += 1
+            self._worker_load[w] += 1
+            self._straggler_reissues_total += 1
+            self._task_qs[w].put(task[:5])
 
     def _release_slot(self, slot: int, gen: int):
         """SlotLease callback: recycle a leased slot. Generation-checked
@@ -434,21 +627,24 @@ class ShmBatchPipeline:
             return
         self._leased.discard(slot)
         self._slot_gen[slot] += 1
-        self._free.append(slot)
+        self._recycle_slot(slot)
 
     def reset(self):
         """Reclaim the ring after an abandoned epoch: wait out (or, on a
-        restart, simply drop) in-flight work, revoke outstanding leases,
-        and mark every slot free. Errors for batches nobody will consume
-        are discarded."""
-        while any(self._outstanding):
+        restart, simply drop) in-flight work — INCLUDING ghost acks from
+        speculated twins, which must drain before a slot may be reused —
+        revoke outstanding leases, and mark every slot free. Errors for
+        batches nobody will consume are discarded."""
+        while any(self._outstanding) or any(self._extra_issues):
             self._handle(self._next_result(requeue=False), mode="discard")
         self._free = list(range(self.slots))
+        self._quarantine.clear()
         self._leased.clear()
         self._slot_gen = [g + 1 for g in self._slot_gen]
         for spans in self._pending.values():
             spans.clear()
         self._retries.clear()
+        self._speculated.clear()
 
     def kill_worker(self, index: int = 0) -> Optional[int]:
         """Fault-injection/debug hook: SIGKILL one live worker process
@@ -469,15 +665,19 @@ class ShmBatchPipeline:
 
     # -- supervision --------------------------------------------------------
 
-    def _next_result(self, requeue: bool = True):
+    def _next_result(self, requeue: bool = True, tick=None):
         """Wait for one worker ack under the watchdog: a dead worker or a
         deadline with zero progress restarts the pool (re-enqueueing the
         unacked spans unless ``requeue`` is off — the reset path drops
         them instead). Liveness is checked BEFORE every wait, not only on
         timeout: a worker that dies idle would otherwise silently shrink
-        the pool forever."""
+        the pool forever. ``tick`` (optional) is called once per poll
+        interval — the straggler-speculation trigger rides it, since a
+        stalled span means no result arrives to return control."""
         deadline = time.monotonic() + self.timeout_s
         while True:
+            if tick is not None:
+                tick()
             dead = [p for p in self._procs if not p.is_alive()]
             if dead:
                 p = dead[0]
@@ -499,8 +699,9 @@ class ShmBatchPipeline:
                     return self._res_q.get(timeout=min(0.2, self.timeout_s))
                 except _queue.Empty:
                     continue
-            if not any(self._outstanding):
-                # a reset-path restart dropped all pending work; nothing
+            if not any(self._outstanding) and not any(self._extra_issues):
+                # a restart dropped all pending work AND vaporized every
+                # ghost issue (the queues died with the pool); nothing
                 # will ever ack, so hand back a sentinel the _handle
                 # modes understand as "no-op"
                 return ("none",)
@@ -560,10 +761,20 @@ class ShmBatchPipeline:
             m for _, m in self._worker_cache.values())
         self._worker_cache.clear()
         self._start_workers()
+        # the old queues died with the pool: every in-flight issue —
+        # including speculated twins — is gone, so ghost accounting
+        # resets and quarantined slots are safe to reuse immediately
+        self._speculated.clear()
+        self._extra_issues = [0] * self.slots
+        if self._quarantine:
+            self._free.extend(sorted(self._quarantine))
+            self._quarantine.clear()
+        self._worker_load = [0] * self.num_workers
         if requeue:
             for spans in self._pending.values():
                 for task in spans.values():
                     self._task_qs[task[5]].put(task[:5])
+                    self._worker_load[task[5]] += 1
         else:
             for spans in self._pending.values():
                 spans.clear()
@@ -573,27 +784,42 @@ class ShmBatchPipeline:
     def _handle(self, msg, mode: str = "normal"):
         """Apply one worker ack. Modes: ``normal`` (collect path — retry
         errored spans up to the budget, then raise with the worker's
-        traceback), ``discard`` (reset path — drop errored spans)."""
+        traceback), ``discard`` (reset path — drop errored spans).
+
+        An ack for a task NO LONGER PENDING is a GHOST — the speculated
+        twin (or a retry the twin beat) finishing late. Ghosts never
+        touch the completion counters (a second decrement would send
+        ``_outstanding`` negative and wedge ``reset``); they only settle
+        the slot's quarantine accounting."""
         kind = msg[0]
         if kind == "none":  # restart-with-drop sentinel from _next_result
             return
         worker_id, slot, task_id = msg[1], msg[2], msg[3]
+        if worker_id < len(self._worker_load):
+            if self._worker_load[worker_id] > 0:
+                self._worker_load[worker_id] -= 1
+            self._worker_last_ack[worker_id] = time.monotonic()
         if kind == "done":
             self._consec_failures = 0  # the pool is making progress
-            self._outstanding[slot] -= 1
-            self._pending[slot].pop(task_id, None)
-            self._retries.pop((slot, task_id), None)
             self._worker_cache[worker_id] = (msg[4], msg[5])
+            if self._pending[slot].pop(task_id, None) is None:
+                self._ghost_ack(slot)
+                return
+            self._outstanding[slot] -= 1
+            self._retries.pop((slot, task_id), None)
             return
         # kind == "error"
+        task = self._pending[slot].get(task_id)
+        if task is None:  # ghost twin errored after the span completed
+            self._ghost_ack(slot)
+            return
         if mode == "discard":
             self._outstanding[slot] -= 1
             self._pending[slot].pop(task_id, None)
             self._retries.pop((slot, task_id), None)
             return
         attempts = self._retries.get((slot, task_id), 0)
-        task = self._pending[slot].get(task_id)
-        if attempts < self.span_retries and task is not None:
+        if attempts < self.span_retries:
             self._retries[(slot, task_id)] = attempts + 1
             self._span_retries_total += 1
             print(
@@ -603,6 +829,13 @@ class ShmBatchPipeline:
                 file=sys.stderr,
             )
             self._task_qs[task[5]].put(task[:5])
+            self._worker_load[task[5]] += 1
+            # the errored copy may have been the speculated twin while
+            # the assigned worker is STILL stalled: forget the
+            # speculation record so a later tick may re-issue — without
+            # this, the retry sits behind the stall and the span can
+            # only complete via watchdog pool restart
+            self._speculated.discard((slot, task_id))
             return
         raise RuntimeError(
             f"data worker {worker_id} failed while decoding (batch "
@@ -649,12 +882,34 @@ class ShmBatchPipeline:
             "collects": self._collects,
         }
 
+    def ring_stats(self) -> dict:
+        """Decode-ahead telemetry, cumulative since pipeline start (the
+        DataLoader folds closed pipelines' totals and turns ``io_wait_s``
+        into a per-feed_stats-call interval): occupancy is sampled at
+        every collect (slots in flight + leased + quarantined, out of
+        ``slots``), ``io_wait_s`` is parent wall time blocked waiting
+        for a slot's spans, and ``straggler_reissues`` counts
+        speculative re-issues to idle workers."""
+        return {
+            "ring_depth": self.slots,
+            "occupancy_sum": self._occ_sum,
+            "occupancy_samples": self._occ_n,
+            "io_wait_s": self._io_wait_s,
+            "straggler_reissues": self._straggler_reissues_total,
+        }
+
     # -- lifecycle ----------------------------------------------------------
 
     def close(self):
         if self._closed:
             return
         self._closed = True
+        # lease-leak bookkeeping for the conftest session guard: a slot
+        # still leased HERE was neither released by its consumer nor
+        # revoked by a reset — a protocol bug worth failing CI over
+        # (the segments themselves are still unlinked below regardless)
+        global _LEASE_LEAKS
+        _LEASE_LEAKS += len(self._leased)
         for q in self._task_qs:
             try:
                 q.put(None)

@@ -79,5 +79,9 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.c_double,
             ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
         ]
+        # cold-epoch byte readahead: posix_fadvise(WILLNEED) the JPEG
+        # files of pre-issued spans (parent-side, GIL released)
+        lib.dptpu_file_readahead.restype = ctypes.c_longlong
+        lib.dptpu_file_readahead.argtypes = [ctypes.c_char_p]
         _cached = lib
         return _cached

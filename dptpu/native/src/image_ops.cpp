@@ -24,6 +24,10 @@
 
 #include <jpeglib.h>
 
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <cmath>
 #include <csetjmp>
@@ -100,6 +104,31 @@ void crop_resize_bilinear(const uint8_t* src, int src_w, int src_h,
 }  // namespace
 
 extern "C" {
+
+// Advise the kernel to pull a file's bytes into the page cache
+// asynchronously (posix_fadvise WILLNEED) — the cold-epoch JPEG
+// readahead path. The parent calls this at span PRE-ISSUE time, so by
+// the time a worker opens the file (decode_ahead batches later) the
+// read services from memory instead of stalling a decode core on disk
+// latency. Returns the file size on success (telemetry-friendly),
+// negative on failure. The GIL is released for the open/advise/close
+// (ctypes does this for every call here), so the parent's submit path
+// pays microseconds, not I/O.
+long long dptpu_file_readahead(const char* path) {
+  const int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  long long size = 0;
+  if (fstat(fd, &st) == 0) size = static_cast<long long>(st.st_size);
+#if defined(POSIX_FADV_WILLNEED)
+  const int rc = posix_fadvise(fd, 0, 0, POSIX_FADV_WILLNEED);
+#else
+  const int rc = 0;  // no fadvise on this platform: open itself primed
+                     // the dentry/inode caches, which is all we can do
+#endif
+  close(fd);
+  return rc == 0 ? size : -2;
+}
 
 // Parse JPEG header only; writes full-resolution dimensions.
 int dptpu_jpeg_dims(const uint8_t* data, size_t size, int* width,

@@ -22,7 +22,13 @@ naturally):
 * ``worker_hang@index=K`` — a data worker decoding sample index K sleeps
   effectively forever. Deterministic (every retry hangs again), so it
   drives the watchdog all the way to pool-restart exhaustion and the
-  graceful degrade to thread mode.
+  graceful degrade to thread mode. Two optional modifiers turn the
+  death into a STRAGGLER: ``s=F`` bounds the sleep to F seconds (a slow
+  span, not a dead one — keep ``DPTPU_WORKER_TIMEOUT_S`` above it so
+  the watchdog stays out of the way), and ``worker=W`` restricts the
+  hang to worker id W — the decode-ahead straggler-injection mode
+  (``worker_hang@index=K@s=2@worker=0``): only W stalls, so the
+  speculative re-issue path can hand the span to a healthy worker.
 
 Worker-side kinds (``io_error``, ``worker_hang``) take effect in spawned
 decode workers, which re-parse the inherited environment — no pickling of
@@ -55,6 +61,8 @@ class _Fault:
     save: Optional[int] = None
     index: Optional[int] = None
     p: float = 0.0
+    seconds: Optional[float] = None  # worker_hang: bounded straggler sleep
+    worker: Optional[int] = None  # worker_hang: only this worker id stalls
     fired: bool = False
 
 
@@ -85,12 +93,18 @@ def _parse_one(spec: str) -> _Fault:
                 f.p = float(val)
                 if not 0.0 <= f.p <= 1.0:
                     raise ValueError
+            elif key == "s":
+                f.seconds = float(val)
+                if f.seconds <= 0.0:
+                    raise ValueError
+            elif key == "worker":
+                f.worker = int(val)
             else:
                 raise KeyError
         except KeyError:
             raise ValueError(
                 f"DPTPU_FAULT modifier key {key!r} in {spec!r} unknown "
-                f"(accepted: step, save, index, p)"
+                f"(accepted: step, save, index, p, s, worker)"
             ) from None
         except ValueError:
             raise ValueError(
@@ -176,8 +190,9 @@ class FaultPlan:
         """Call per sample decode inside a data worker; may hang or raise
         an injected transient ``OSError``."""
         for f in self.faults:
-            if f.kind == "worker_hang" and index == f.index:
-                time.sleep(_HANG_SECONDS)
+            if f.kind == "worker_hang" and index == f.index \
+                    and (f.worker is None or f.worker == worker_id):
+                time.sleep(f.seconds if f.seconds else _HANG_SECONDS)
             elif f.kind == "io_error":
                 if self._worker_rng is None:
                     self._worker_rng = random.Random(

@@ -897,6 +897,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                         "Feed/bytes_copied_per_batch",
                         train_stats["bytes_copied_per_batch"], epoch + 1,
                     )
+                # decode-ahead ring health: how full the slot ring ran,
+                # how many batches were pre-issued, straggler re-issues,
+                # and the parent's per-epoch span-wait (I/O wait) time
+                for tag, key in (
+                    ("Feed/ring_occupancy", "ring_occupancy"),
+                    ("Feed/issue_ahead_depth", "issue_ahead_depth"),
+                    ("Feed/straggler_reissues", "straggler_reissues"),
+                    ("Feed/io_wait_s", "io_wait_s"),
+                ):
+                    if key in train_stats:
+                        writer.add_scalar(tag, train_stats[key], epoch + 1)
                 writer.add_scalar("Loss/train", train_stats["loss"], epoch + 1)
                 writer.add_scalar("Loss/val", val_stats["loss"], epoch + 1)
                 writer.add_scalar("Top1/train", train_stats["top1"], epoch + 1)

@@ -42,8 +42,17 @@ hoping "32 threads" is enough. The companion runtime metric is the
 time the chip waited on host data — dptpu/train/loop.py); this script
 bounds feedability offline, the meter proves it online.
 
+Round 8 adds ``--ring-sweep``: the decode-ahead pipelined feed A/Bs —
+ring depth × ``decode_ahead`` grid (cold + warm), an injected-straggler
+batch-interval tail comparison (``DPTPU_FAULT=worker_hang`` straggler
+mode: one worker sleeps on one sample; serial/no-speculation vs
+deep-ring + speculative re-issue), and the cold-epoch
+``posix_fadvise(WILLNEED)`` readahead A/B (with the page-cache honesty
+caveat recorded in the artifact).
+
 Usage: python scripts/bench_host_pipeline.py [--images 512] [--seconds 6]
                                              [--chip-rate 2730]
+                                             [--ring-sweep]
 """
 
 import argparse
@@ -166,7 +175,9 @@ class LoaderBench:
 
     def __init__(self, root, n_workers, workers_mode="thread",
                  cache_bytes=0, cache_scope="sharded", leased=False,
-                 span_affinity=True, warm_epochs=1):
+                 span_affinity=True, warm_epochs=1,
+                 ring_depth=None, decode_ahead=None, speculate=None,
+                 speculate_after_s=0.5, readahead=None):
         from dptpu.data import (
             DataLoader,
             ImageFolderDataset,
@@ -188,7 +199,12 @@ class LoaderBench:
                                  drop_last=True,
                                  workers_mode=workers_mode,
                                  leased=leased,
-                                 span_affinity=span_affinity)
+                                 span_affinity=span_affinity,
+                                 ring_depth=ring_depth,
+                                 decode_ahead=decode_ahead,
+                                 speculate=speculate,
+                                 speculate_after_s=speculate_after_s,
+                                 readahead=readahead)
         self.epoch = 0
         # untimed warm passes: absorb worker-process spawn + native-lib
         # load for every mode equally, and fill the decode cache so
@@ -216,6 +232,22 @@ class LoaderBench:
             self.epoch += 1
         return done / (time.perf_counter() - t0)
 
+    def measure_intervals(self, epochs):
+        """Per-batch arrival intervals (seconds) over ``epochs`` full
+        epochs — the straggler-tail metric: a span that gates its
+        batch's collect shows up as a fat interval, and decode-ahead +
+        speculation exist to shave exactly that tail."""
+        ivals = []
+        for _ in range(epochs):
+            t = time.perf_counter()
+            for b in self.loader.epoch(self.epoch):
+                self._done_with(b)
+                now = time.perf_counter()
+                ivals.append(now - t)
+                t = now
+            self.epoch += 1
+        return ivals
+
     def stats(self):
         return self.loader.feed_stats()
 
@@ -223,11 +255,161 @@ class LoaderBench:
         self.loader.close()
 
 
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def ring_sweep(train_root, args, results, cores):
+    """Round-8 decode-ahead sweep (``--ring-sweep``):
+
+    * depth × decode-ahead grid, cold (no cache) and warm, interleaved
+      best-of rounds like every other loader number here;
+    * straggler A/B: ``DPTPU_FAULT=worker_hang@index=K@s=F@worker=0``
+      stalls ONE worker on ONE sample per epoch; batch-interval tail
+      (p50/p90/max) for the batch-serial baseline (decode_ahead=1, no
+      speculation) vs the pipelined ring (decode_ahead=4 + speculative
+      re-issue);
+    * readahead A/B: cold epochs with the parent-side
+      posix_fadvise(WILLNEED) byte prefetch on vs off. Honesty caveat,
+      recorded in the artifact: the JPEGs were just generated, so the
+      page cache is already warm and parity is the EXPECTED result
+      here — the A/B exists to prove the path costs nothing; the win
+      needs a cold cache (or a real disk) to show.
+    """
+    from dptpu.data.shm import _affinity_of
+
+    cache_budget = args.cache_mb << 20
+    grid = [(a, a + 3) for a in (1, 2, 4, 8)]
+    benches = {}
+    for ahead, ring in grid:
+        benches[("cold", ahead, ring)] = LoaderBench(
+            train_root, cores, workers_mode="process",
+            decode_ahead=ahead, ring_depth=ring)
+    for ahead, ring in ((1, 4), (4, 7)):
+        benches[("warm", ahead, ring)] = LoaderBench(
+            train_root, cores, workers_mode="process",
+            cache_bytes=cache_budget, cache_scope="pooled",
+            decode_ahead=ahead, ring_depth=ring, warm_epochs=2)
+    best = {k: 0.0 for k in benches}
+    for _ in range(args.rounds):
+        for k in benches:
+            best[k] = max(best[k], benches[k].measure(args.seconds))
+    stats = {k: benches[k].stats() for k in benches}
+    for b in benches.values():
+        b.close()
+    sweep = []
+    for (kind, ahead, ring), rate in sorted(best.items()):
+        fs = stats[(kind, ahead, ring)]
+        entry = {"cache": kind, "decode_ahead": ahead, "ring_depth": ring,
+                 "images_per_sec": round(rate, 1),
+                 "issue_ahead_depth": round(
+                     fs.get("issue_ahead_depth", 0.0), 2),
+                 "ring_occupancy": round(fs.get("ring_occupancy", 0.0), 2)}
+        sweep.append(entry)
+        print(f"ring {kind:4s} ahead={ahead} depth={ring} "
+              f"{rate:8.1f} img/s (issue_ahead "
+              f"{entry['issue_ahead_depth']:.2f}, occ "
+              f"{entry['ring_occupancy']:.2f})")
+    results["ring_sweep"] = sweep
+    results["ring_sweep_rounds"] = args.rounds
+
+    # straggler A/B: one worker stalls straggler_s once per epoch
+    stall = next(i for i in range(args.images)
+                 if _affinity_of(i, cores) == 0)
+    os.environ["DPTPU_FAULT"] = (
+        f"worker_hang@index={stall}@s={args.straggler_s}@worker=0"
+    )
+    os.environ["DPTPU_WORKER_TIMEOUT_S"] = "60"
+    try:
+        ab = {}
+        for name, (ahead, spec) in (
+            ("serial_no_speculation", (1, False)),
+            ("ahead4_speculation", (4, True)),
+        ):
+            lb = LoaderBench(train_root, cores, workers_mode="process",
+                             decode_ahead=ahead, ring_depth=ahead + 3,
+                             speculate=spec, speculate_after_s=0.25)
+            ivals = sorted(lb.measure_intervals(args.straggler_epochs))
+            fs = lb.stats()
+            lb.close()
+            ab[name] = {
+                "decode_ahead": ahead, "speculate": spec,
+                "batches": len(ivals),
+                "interval_p50_ms": round(
+                    1000 * _percentile(ivals, 0.50), 1),
+                "interval_p90_ms": round(
+                    1000 * _percentile(ivals, 0.90), 1),
+                "interval_max_ms": round(1000 * ivals[-1], 1),
+                "straggler_reissues": fs.get("straggler_reissues", 0),
+            }
+            print(f"straggler {name}: p50 "
+                  f"{ab[name]['interval_p50_ms']:.0f} ms, p90 "
+                  f"{ab[name]['interval_p90_ms']:.0f} ms, max "
+                  f"{ab[name]['interval_max_ms']:.0f} ms, reissues "
+                  f"{ab[name]['straggler_reissues']}")
+        ab["fault"] = os.environ["DPTPU_FAULT"]
+        ab["note"] = (
+            "one injected straggler per epoch (worker 0 sleeps "
+            f"{args.straggler_s}s on one sample); intervals over "
+            f"{args.straggler_epochs} epochs"
+        )
+        results["straggler_ab"] = ab
+    finally:
+        os.environ.pop("DPTPU_FAULT", None)
+        os.environ.pop("DPTPU_WORKER_TIMEOUT_S", None)
+
+    # readahead A/B (page-cache caveat above)
+    ra = {}
+    benches = {
+        flag: LoaderBench(train_root, cores, workers_mode="process",
+                          decode_ahead=4, ring_depth=7, readahead=flag)
+        for flag in (False, True)
+    }
+    best = {k: 0.0 for k in benches}
+    for _ in range(args.rounds):
+        for k in benches:
+            best[k] = max(best[k], benches[k].measure(args.seconds))
+    for k, b in benches.items():
+        b.close()
+    ra = {
+        "off_images_per_sec": round(best[False], 1),
+        "on_images_per_sec": round(best[True], 1),
+        "on_over_off": (round(best[True] / best[False], 3)
+                        if best[False] else None),
+        "note": ("fixture JPEGs were just written, so the page cache is "
+                 "already warm: parity proves the fadvise path is free; "
+                 "the win requires genuinely cold files"),
+    }
+    results["readahead_ab"] = ra
+    print(f"readahead cold-epoch A/B: off {best[False]:.1f} vs on "
+          f"{best[True]:.1f} img/s ({ra['on_over_off']}x; page-cache "
+          f"caveat recorded)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--images", type=int, default=256)
     ap.add_argument("--seconds", type=float, default=6.0)
     ap.add_argument("--out", default="HOSTBENCH.json")
+    ap.add_argument(
+        "--ring-sweep", action="store_true",
+        help="run the round-8 decode-ahead sweep: ring depth × "
+             "decode-ahead grid (cold + warm), straggler-injection "
+             "batch-interval A/B (DPTPU_FAULT=worker_hang straggler "
+             "mode), and the cold-epoch readahead A/B",
+    )
+    ap.add_argument(
+        "--straggler-s", type=float, default=1.0,
+        help="straggler sleep injected per epoch in the --ring-sweep "
+             "A/B (worker 0, one sample)",
+    )
+    ap.add_argument(
+        "--straggler-epochs", type=int, default=6,
+        help="epochs of batch intervals per straggler A/B arm",
+    )
     ap.add_argument(
         "--chip-rate", type=float, default=2730.0,
         help="per-chip training step rate to budget against "
@@ -256,7 +438,7 @@ def main():
     have_native = native_image.available()
 
     cores = os.cpu_count() or 1
-    results = {"round": 7, "native_available": have_native,
+    results = {"round": 8, "native_available": have_native,
                "jpeg": "500x400 q85",
                "transform": "RandomResizedCrop(224)+flip",
                "host_cpu_count": cores,
@@ -559,6 +741,9 @@ def main():
             f"cache-warm: {warm_per_core:.1f} img/s/core → "
             f"{math.ceil(needed_warm)} cores per chip"
         )
+
+    if args.ring_sweep:
+        ring_sweep(train_root, args, results, cores)
 
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)

@@ -25,6 +25,14 @@ synthetic data, each compared against one uninterrupted baseline run:
                       cache-off baseline bit for bit (the slab survives
                       the pool restart warm, and warm ≡ cold by the
                       hit≡miss contract).
+* ``worker_kill_ahead`` — the round-8 decode-ahead feed under chaos:
+                      deep ring (DPTPU_RING_DEPTH=8), spans pre-issued
+                      for DPTPU_DECODE_AHEAD=5 future batches,
+                      straggler speculation armed, worker SIGKILLed
+                      mid-run; the supervisor re-enqueues every
+                      pre-issued span and the run stays bit-identical
+                      (duplicate span completions are first-writer-wins
+                      by construction).
 
 Writes ``FAULTBENCH.json`` at the repo root: faults injected, recoveries
 (pool restarts / span retries / resume fallbacks), and the resume
@@ -59,7 +67,8 @@ from dptpu.train import fit  # noqa: E402
 _ENV_KNOBS = ("DPTPU_FAULT", "DPTPU_FAULT_SEED", "DPTPU_WORKERS_MODE",
               "DPTPU_SPAN_RETRIES", "DPTPU_WORKER_TIMEOUT_S",
               "DPTPU_POOL_RESTARTS", "DPTPU_CACHE_BYTES",
-              "DPTPU_CACHE_SCOPE", "DPTPU_LEASE")
+              "DPTPU_CACHE_SCOPE", "DPTPU_LEASE", "DPTPU_RING_DEPTH",
+              "DPTPU_DECODE_AHEAD", "DPTPU_SPECULATE", "DPTPU_READAHEAD")
 
 
 def make_jpeg_imagefolder(root, n_train, n_val, n_classes=2):
@@ -260,6 +269,34 @@ def main():
             last.get("train_bytes_copied_per_batch", -1.0)),
         "params_max_delta": params_max_delta(jbase["state"], r["state"]),
         "max_abs_dloss": trajectory_delta(jbase["history"], r["history"]),
+    })
+
+    # 6. worker_kill_ahead: the round-8 decode-ahead feed under chaos —
+    # deep ring, spans for several future batches pre-issued, straggler
+    # SPECULATION armed, and a worker SIGKILLed mid-run: the supervisor
+    # must re-enqueue every pre-issued span and the run must stay
+    # bit-identical to the plain baseline (first-writer-wins duplicate
+    # completions included)
+    d = os.path.join(root, "worker_kill_ahead")
+    r = run_fit(cfg, args.image_size, d,
+                env={"DPTPU_FAULT": f"worker_kill@step={kill_step}",
+                     "DPTPU_WORKERS_MODE": "process",
+                     "DPTPU_DECODE_AHEAD": "5",
+                     "DPTPU_RING_DEPTH": "8",
+                     "DPTPU_SPECULATE": "1"})
+    last = r["history"][-1] if r["history"] else {}
+    scenarios.append({
+        "name": "worker_kill_ahead",
+        "fault": f"worker_kill@step={kill_step}",
+        "preempted": bool(r["preempted"]),
+        "recoveries": recoveries(r),
+        "ring_depth": int(last.get("train_ring_depth", 0)),
+        "issue_ahead_depth": float(
+            last.get("train_issue_ahead_depth", 0.0)),
+        "straggler_reissues": int(
+            last.get("train_straggler_reissues", 0)),
+        "params_max_delta": params_max_delta(base["state"], r["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r["history"]),
     })
 
     for s in scenarios:

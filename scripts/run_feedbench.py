@@ -32,11 +32,19 @@ Round 7 adds the pooled-feed knobs: --cache-scope (pooled cross-process
 records ``bytes_copied_per_batch`` per epoch: 0 proves the parent-side
 copy-out is gone end to end through fit().
 
+Round 8 drives the decode-ahead pipelined feed through fit():
+--ring-depth / --decode-ahead / --speculate / --readahead map to
+DPTPU_RING_DEPTH / DPTPU_DECODE_AHEAD / DPTPU_SPECULATE /
+DPTPU_READAHEAD, and the per-epoch record gains the new ring telemetry
+(ring occupancy, issue-ahead depth, straggler re-issues, I/O wait).
+
 Usage: python scripts/run_feedbench.py [--images 1280] [--epochs 10]
                                        [--batch 64] [--workers-mode process]
                                        [--cache-mb 512]
                                        [--cache-scope auto|pooled|sharded]
-                                       [--lease 1|0]
+                                       [--lease 1|0] [--ring-depth N]
+                                       [--decode-ahead N] [--speculate 1|0]
+                                       [--readahead 1|0]
 """
 
 import argparse
@@ -107,6 +115,26 @@ def main():
         help="1 = consumer-leased zero-copy batch slots (process mode; "
              "bytes_copied_per_batch = 0); 0 = legacy parent copy-out",
     )
+    ap.add_argument(
+        "--ring-depth", type=int, default=None,
+        help="total batch slots in the shared-memory ring "
+             "(DPTPU_RING_DEPTH; default: derived from the issue "
+             "window + lease depth)",
+    )
+    ap.add_argument(
+        "--decode-ahead", type=int, default=None,
+        help="batches whose spans are pre-issued ahead of the consume "
+             "point (DPTPU_DECODE_AHEAD; 1 = batch-serial baseline)",
+    )
+    ap.add_argument(
+        "--speculate", type=int, default=None, choices=(0, 1),
+        help="speculative straggler span re-issue (DPTPU_SPECULATE)",
+    )
+    ap.add_argument(
+        "--readahead", type=int, default=None, choices=(0, 1),
+        help="cold-epoch posix_fadvise(WILLNEED) JPEG byte readahead "
+             "at span pre-issue (DPTPU_READAHEAD)",
+    )
     ap.add_argument("--out", default="FEEDBENCH.json")
     args = ap.parse_args()
 
@@ -117,6 +145,12 @@ def main():
     if args.cache_scope != "auto":
         os.environ["DPTPU_CACHE_SCOPE"] = args.cache_scope
     os.environ["DPTPU_LEASE"] = str(args.lease)
+    for flag, knob in ((args.ring_depth, "DPTPU_RING_DEPTH"),
+                       (args.decode_ahead, "DPTPU_DECODE_AHEAD"),
+                       (args.speculate, "DPTPU_SPECULATE"),
+                       (args.readahead, "DPTPU_READAHEAD")):
+        if flag is not None:
+            os.environ[knob] = str(flag)
 
     from dptpu.config import Config
     from dptpu.data import native_image
@@ -183,7 +217,7 @@ def main():
         }
 
     out = {
-        "round": 7,
+        "round": 8,
         "what": ("fit() on real on-disk JPEGs, native decode, "
                  + ("real chip" if jax.default_backend() == "tpu"
                     else f"{jax.default_backend()} backend")),
@@ -200,6 +234,22 @@ def main():
         "cache_scope": (hist[-1].get("train_cache_scope")
                         if hist else args.cache_scope),
         "leased": bool(args.lease),
+        "ring_depth": (hist[-1].get("train_ring_depth")
+                       if hist else args.ring_depth),
+        "decode_ahead": args.decode_ahead,
+        "speculate": args.speculate,
+        "readahead": args.readahead,
+        "issue_ahead_depth": (
+            round(float(np.mean([h.get("train_issue_ahead_depth", 0.0)
+                                 for h in steady])), 2)),
+        "ring_occupancy": (
+            round(float(np.mean([h.get("train_ring_occupancy", 0.0)
+                                 for h in steady])), 2)),
+        "straggler_reissues": int(
+            hist[-1].get("train_straggler_reissues", 0)) if hist else 0,
+        "io_wait_s_per_epoch": (
+            round(float(np.mean([h.get("train_io_wait_s", 0.0)
+                                 for h in steady])), 3)),
         "bytes_copied_per_batch": round(copied, 1),
         "epochs": len(hist),
         "steps_total": steps_per_epoch * len(hist),
@@ -226,6 +276,16 @@ def main():
                 "bytes_copied_per_batch": round(
                     h.get("train_bytes_copied_per_batch", 0.0), 1
                 ),
+                "ring_occupancy": round(
+                    h.get("train_ring_occupancy", 0.0), 2
+                ),
+                "issue_ahead_depth": round(
+                    h.get("train_issue_ahead_depth", 0.0), 2
+                ),
+                "io_wait_s": round(h.get("train_io_wait_s", 0.0), 3),
+                "straggler_reissues": int(
+                    h.get("train_straggler_reissues", 0)
+                ),
             }
             for h in hist
         ],
@@ -236,7 +296,8 @@ def main():
         "images_per_sec", "starvation", "data_time_s", "batch_time_s",
         "cache_hit_rate", "cache_scope", "leased",
         "bytes_copied_per_batch", "workers_mode", "host_cpu_count",
-        "steps_total")}))
+        "steps_total", "ring_depth", "issue_ahead_depth",
+        "ring_occupancy", "io_wait_s_per_epoch", "straggler_reissues")}))
     print(f"wrote {args.out}")
     return 0
 

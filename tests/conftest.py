@@ -56,11 +56,30 @@ def dptpu_shm_leak_guard():
     appears during the suite must be gone — or still owned by a live,
     registered object whose atexit hook will unlink it — by session end.
     A segment that is neither was abandoned without ``close()`` and
-    would leak host RAM until reboot in production."""
+    would leak host RAM until reboot in production.
+
+    Also policed: ring LEASES. A slot still leased when its pipeline
+    closed was neither released by the consumer nor revoked by an
+    epoch reset / loader-initiated rebuild — a zero-copy protocol bug
+    that would pin (and, worse, silently recycle under) live batch
+    views in production. ``shm.leaked_lease_count()`` only advances on
+    close-with-lease-outstanding, so abandoned epochs whose leases the
+    generator backstop or a reset reclaimed stay clean."""
     import glob
 
+    from dptpu.data import shm as _shm
+
+    leases_before = _shm.leaked_lease_count()
     if not os.path.isdir("/dev/shm"):
-        yield  # platform without a tmpfs view; nothing to police
+        yield  # platform without a tmpfs view; segments can't be policed
+        import gc
+
+        gc.collect()
+        assert _shm.leaked_lease_count() == leases_before, (
+            "ring slots were still leased when their pipeline closed "
+            "(consumer never released, no reset revoked) — a zero-copy "
+            "lease leak"
+        )
         return
     # segment names embed their CREATOR pid (dptpu_{kind}_{pid}_{hex});
     # only this process creates segments for this suite (workers merely
@@ -74,7 +93,6 @@ def dptpu_shm_leak_guard():
     import gc
 
     gc.collect()  # run __del__ for dropped loaders/datasets first
-    from dptpu.data import shm as _shm
     from dptpu.data import shm_cache as _shm_cache
 
     live = {
@@ -87,6 +105,11 @@ def dptpu_shm_leak_guard():
         f"leaked /dev/shm segments (created during the suite, not "
         f"closed, not owned by any live pipeline/cache): "
         f"{sorted(leaked)}"
+    )
+    assert _shm.leaked_lease_count() == leases_before, (
+        "ring slots were still leased when their pipeline closed "
+        "(consumer never released, no reset revoked) — a zero-copy "
+        "lease leak"
     )
 
 

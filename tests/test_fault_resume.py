@@ -101,6 +101,33 @@ def test_sigterm_midepoch_resume_is_bit_identical(baseline, tmp_path,
         r2["history"][1]["train_loss"]
 
 
+def test_midepoch_resume_under_decode_ahead(baseline, tmp_path,
+                                            monkeypatch):
+    """The lookahead-resume contract (ISSUE 4): with the process-mode
+    decode-ahead ring pre-issuing spans for several future batches —
+    plus speculation armed — a SIGTERM mid-epoch must still save the
+    exact consumed position (pre-issued-but-unconsumed batches do NOT
+    count), and ``--resume`` must replay to it bit-identically against
+    the thread-mode, no-lookahead baseline."""
+    monkeypatch.chdir(tmp_path)
+    for k, v in (("DPTPU_WORKERS_MODE", "process"),
+                 ("DPTPU_DECODE_AHEAD", "4"),
+                 ("DPTPU_RING_DEPTH", "8"),
+                 ("DPTPU_SPECULATE", "1"),
+                 ("DPTPU_FAULT", "sigterm@step=2")):
+        monkeypatch.setenv(k, v)
+    r1 = fit(_cfg(), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    assert os.path.exists(step_checkpoint_name(0, 2))
+
+    monkeypatch.delenv("DPTPU_FAULT")
+    r2 = fit(_cfg(resume="."), image_size=32, verbose=False)
+    assert r2["epochs_run"] == 2
+    assert _params_max_delta(baseline["state"], r2["state"]) == 0.0
+    for hb, hr in zip(baseline["history"], r2["history"]):
+        assert hb["val_loss"] == hr["val_loss"]
+
+
 def test_ckpt_steps_rotation_and_corrupt_fallback(baseline, tmp_path,
                                                   monkeypatch):
     monkeypatch.chdir(tmp_path)

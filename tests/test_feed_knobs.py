@@ -92,6 +92,55 @@ def test_lease_depth_knob_validated():
         DataLoader(SyntheticDataset(8, 8, 4), 4, lease_depth=0)
 
 
+def test_ring_depth_and_decode_ahead_knobs_validated(monkeypatch):
+    """The decode-ahead pipeline knobs under the locked fail-fast
+    contract: 0, negatives and garbage all raise with the knob's name —
+    the DPTPU_TP=0 discipline, not a silent fallback."""
+    from dptpu.data import DataLoader, SyntheticDataset
+
+    ds = SyntheticDataset(8, 8, 4)
+    for knob, ctor_kw, bads in (
+        ("DPTPU_RING_DEPTH", "ring_depth", ("0", "1", "-3")),
+        ("DPTPU_DECODE_AHEAD", "decode_ahead", ("0", "-1")),
+    ):
+        for bad in bads:
+            monkeypatch.setenv(knob, bad)
+            with pytest.raises(ValueError, match=knob):
+                DataLoader(ds, 4)
+            monkeypatch.delenv(knob)
+            # ctor args hit the same validation as the env knob
+            with pytest.raises(ValueError, match=knob):
+                DataLoader(ds, 4, **{ctor_kw: int(bad)})
+        monkeypatch.setenv(knob, "plenty")
+        with pytest.raises(ValueError, match="not an integer"):
+            DataLoader(ds, 4)
+        monkeypatch.delenv(knob)
+    # valid explicit values construct fine and land on the loader
+    monkeypatch.setenv("DPTPU_RING_DEPTH", "8")
+    monkeypatch.setenv("DPTPU_DECODE_AHEAD", "1")
+    dl = DataLoader(ds, 4)
+    assert (dl.ring_depth, dl.decode_ahead) == (8, 1)
+    dl.close()
+
+
+def test_speculate_and_readahead_knobs_validated(monkeypatch):
+    from dptpu.data import DataLoader, SyntheticDataset
+
+    ds = SyntheticDataset(8, 8, 4)
+    for knob in ("DPTPU_SPECULATE", "DPTPU_READAHEAD"):
+        monkeypatch.setenv(knob, "maybe")
+        with pytest.raises(ValueError, match=knob):
+            DataLoader(ds, 4)
+        monkeypatch.setenv(knob, "0")
+        dl = DataLoader(ds, 4)
+        assert getattr(dl, knob.split("_", 1)[1].lower()) is False
+        dl.close()
+        monkeypatch.delenv(knob)
+    dl = DataLoader(ds, 4)  # defaults: speculation + readahead on
+    assert dl.speculate is True and dl.readahead is True
+    dl.close()
+
+
 def test_env_bool_and_choice_contract(monkeypatch):
     from dptpu.envknob import env_bool, env_choice
 

@@ -74,10 +74,30 @@ def test_fault_spec_parses_all_kinds():
     "sigterm",              # missing required @step
     "worker_hang",          # missing required @index
     "sigterm@tick=3",       # unknown modifier key
+    "worker_hang@index=2@s=0",     # straggler sleep must be > 0
+    "worker_hang@index=2@s=soon",  # non-numeric sleep
 ])
 def test_fault_spec_rejects_typos(bad):
     with pytest.raises(ValueError):
         FaultPlan(bad)
+
+
+def test_worker_hang_straggler_modifiers(monkeypatch):
+    """``s=``/``worker=`` turn the forever-hang into a bounded straggler
+    restricted to one worker id — the decode-ahead speculation A/B's
+    injection vehicle."""
+    import time as _time
+
+    p = FaultPlan("worker_hang@index=4@s=0.05@worker=1")
+    f = p.faults[0]
+    assert (f.index, f.seconds, f.worker) == (4, pytest.approx(0.05), 1)
+    t0 = _time.monotonic()
+    p.worker_decode_hook(worker_id=0, index=4)  # wrong worker: no hang
+    p.worker_decode_hook(worker_id=1, index=3)  # wrong index: no hang
+    assert _time.monotonic() - t0 < 0.04
+    t0 = _time.monotonic()
+    p.worker_decode_hook(worker_id=1, index=4)  # the straggler
+    assert _time.monotonic() - t0 >= 0.05
 
 
 def test_fault_plan_from_env(monkeypatch):

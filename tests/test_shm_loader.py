@@ -271,6 +271,185 @@ def test_degrade_to_thread_with_leases_held(monkeypatch):
         pr.close()
 
 
+# -- decode-ahead pipelined feed -------------------------------------------
+
+def test_decode_ahead_bit_identical_across_depths(jpeg_folder):
+    """The tentpole contract: deep multi-batch span pre-issue (out-of-
+    order completion, workers rolling across batch boundaries) changes
+    NOTHING about the bytes — decode_ahead=1 (batch-serial baseline),
+    a deep ring, and thread mode all agree bit for bit, epoch after
+    epoch."""
+    ds = ImageFolderDataset(jpeg_folder, train_transform(48))
+    th = DataLoader(ds, 4, num_workers=2, seed=5)
+    serial = DataLoader(ds, 4, num_workers=2, seed=5,
+                        workers_mode="process", decode_ahead=1)
+    deep = DataLoader(ds, 4, num_workers=2, seed=5,
+                      workers_mode="process", decode_ahead=5, ring_depth=8)
+    try:
+        for epoch in (0, 1):
+            ref = list(th.epoch(epoch))
+            _assert_batches_equal(ref, list(serial.epoch(epoch)))
+            _assert_batches_equal(ref, list(deep.epoch(epoch)))
+        fs = deep.feed_stats()
+        assert fs["ring_depth"] == 8
+        # the pump actually ran ahead (5 batches of lookahead over the
+        # 5-batch epoch: every non-tail collect saw > 1 pre-issued)
+        assert fs["issue_ahead_depth"] > 1.0
+        assert serial.feed_stats()["issue_ahead_depth"] == 1.0
+    finally:
+        th.close()
+        serial.close()
+        deep.close()
+
+
+def test_straggler_speculation_keeps_bit_identity(monkeypatch):
+    """A worker stalled mid-span (worker_hang straggler mode: only
+    worker 0, bounded sleep) must not gate the epoch: speculation
+    re-issues its spans to a healthy worker, first-writer-wins, and the
+    late twin's ghost ack is absorbed without corrupting any later
+    batch — everything stays bit-identical, including the NEXT epoch
+    (whose slots must not be recycled under a still-writing ghost)."""
+    from dptpu.data import SyntheticDataset
+    from dptpu.data.shm import _affinity_of
+
+    ds = SyntheticDataset(48, 8, 10)
+    th = DataLoader(ds, 8, num_workers=2, seed=3)
+    stall = next(i for i in range(48) if _affinity_of(i, 2) == 0)
+    monkeypatch.setenv("DPTPU_FAULT",
+                       f"worker_hang@index={stall}@s=1@worker=0")
+    monkeypatch.setenv("DPTPU_WORKER_TIMEOUT_S", "30")
+    pr = DataLoader(ds, 8, num_workers=2, seed=3, workers_mode="process",
+                    decode_ahead=4, ring_depth=8, speculate_after_s=0.1)
+    try:
+        ref0, ref1 = list(th.epoch(0)), list(th.epoch(1))
+        _assert_batches_equal(ref0, list(pr.epoch(0)))
+        fs = pr.feed_stats()
+        assert fs["straggler_reissues"] >= 1
+        # epoch 1 re-stalls on the same index; the ring keeps flowing
+        # and the bytes keep matching (ghost quarantine did its job)
+        _assert_batches_equal(ref1, list(pr.epoch(1)))
+        assert pr.workers_mode == "process"  # no restart exhaustion
+    finally:
+        th.close()
+        pr.close()
+
+
+def test_duplicate_span_completion_is_ghosted():
+    """Unit-level dup-ack safety: a second 'done' for an already-
+    completed span (the speculative twin finishing late) must not drive
+    the slot's completion counter negative or double-free the slot."""
+    from dptpu.data import SyntheticDataset
+
+    ds = SyntheticDataset(16, 8, 10)
+    pr = DataLoader(ds, 8, num_workers=2, seed=0, workers_mode="process",
+                    decode_ahead=1)
+    try:
+        batches = list(pr.epoch(0))
+        assert len(batches) == 2
+        pipe = pr._pipeline
+        free_before = pipe.free_slot_count()
+        # forge the late twin's acks: done AND error flavors of a span
+        # that was already completed and whose slot was recycled
+        pipe._extra_issues[0] = 2
+        pipe._handle(("done", 0, 0, 0, 0, 0), mode="normal")
+        pipe._handle(("error", 1, 0, 0, "late twin traceback"),
+                     mode="normal")
+        assert pipe._outstanding[0] == 0  # never went negative
+        assert pipe._extra_issues[0] == 0  # both ghosts absorbed
+        assert pipe.free_slot_count() == free_before  # no double-free
+        # the ring still works end to end after the ghosts
+        assert len(list(pr.epoch(1))) == 2
+    finally:
+        pr.close()
+
+
+def test_pool_restart_with_preissued_spans_in_flight():
+    """Supervisor restart under deep lookahead: killing a worker while
+    spans for several future batches sit in its queue must re-enqueue
+    ALL of them (the _pending map spans every pre-issued slot) and the
+    epoch must complete bit-identically."""
+    from dptpu.data import SyntheticDataset
+
+    ds = SyntheticDataset(48, 8, 10)
+    th = DataLoader(ds, 8, num_workers=2, seed=3)
+    pr = DataLoader(ds, 8, num_workers=2, seed=3, workers_mode="process",
+                    decode_ahead=5, ring_depth=8)
+    try:
+        ref = list(th.epoch(0))
+        it = pr.epoch(0)
+        got = [next(it)]  # the pump has now pre-issued deep lookahead
+        assert pr.kill_one_worker() is not None
+        got += list(it)
+        _assert_batches_equal(ref, got)
+        fs = pr.feed_stats()
+        assert fs["pool_restarts"] >= 1
+        assert "degraded" not in fs
+    finally:
+        th.close()
+        pr.close()
+
+
+def test_ring_rebuild_handles_shrink_and_lease_carryover():
+    """The epoch ring-rebuild fix: a depth change between epochs
+    rebuilds the ring in BOTH directions (growth AND shrink — the old
+    code only grew), and a lease carried over from an abandoned epoch
+    is revoked by the loader-initiated rebuild, not reported as a
+    leak; its late release voids against the closed pipeline."""
+    import dptpu.data.shm as shm
+    from dptpu.data import SyntheticDataset
+
+    leaks_before = shm.leaked_lease_count()
+    ds = SyntheticDataset(48, 8, 10)
+    th = DataLoader(ds, 8, num_workers=2, seed=3)
+    pr = DataLoader(ds, 8, num_workers=2, seed=3, workers_mode="process",
+                    leased=True)
+    try:
+        ref = list(th.epoch(1))
+        it0 = pr.epoch(0, prefetch_batches=8)  # window 9 → deep ring
+        b0 = next(it0)
+        big = pr._pipeline.slots
+        lease = b0["_lease"]  # deliberately NOT released; epoch abandoned
+        # shrink: the next epoch wants a much smaller window. Leased
+        # batches are views — copy before advancing (the lease contract)
+        got = [
+            {"images": np.array(b["images"]), "labels": np.array(b["labels"])}
+            for b in pr.epoch(1, prefetch_batches=0)
+        ]
+        small = pr._pipeline.slots
+        assert small < big  # the ring actually rebuilt downward
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a["images"], b["images"])
+            np.testing.assert_array_equal(a["labels"], b["labels"])
+        lease.release()  # stale: voids against the closed old pipeline
+        assert shm.leaked_lease_count() == leaks_before  # forgiven
+    finally:
+        del it0
+        th.close()
+        pr.close()
+    assert shm.leaked_lease_count() == leaks_before
+
+
+def test_close_with_unreleased_lease_counts_as_leak():
+    """The conftest lease-leak guard's hook: closing the loader while a
+    consumer still holds an unreleased lease (no reset/rebuild ever
+    revoked it) must advance the module leak counter."""
+    import dptpu.data.shm as shm
+    from dptpu.data import SyntheticDataset
+
+    before = shm.leaked_lease_count()
+    ds = SyntheticDataset(24, 8, 10)
+    pr = DataLoader(ds, 8, num_workers=2, seed=0, workers_mode="process",
+                    leased=True)
+    it = pr.epoch(0)
+    batch = next(it)  # generator suspended: the backstop has NOT run
+    pr.close()
+    assert shm.leaked_lease_count() == before + 1
+    # this leak was deliberate — restore the counter so the session
+    # fixture keeps policing the REST of the suite
+    shm._LEASE_LEAKS = before
+    del batch, it
+
+
 def test_affinity_off_still_bit_identical(jpeg_folder):
     ds = ImageFolderDataset(jpeg_folder, train_transform(48))
     th = DataLoader(ds, 4, num_workers=2, seed=3)
