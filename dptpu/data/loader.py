@@ -66,6 +66,7 @@ import numpy as np
 
 import jax
 
+from dptpu import obs
 from dptpu.data.sampler import ShardedSampler
 
 
@@ -239,8 +240,11 @@ class DataLoader:
         return futs, imgs, labels, n_valid
 
     def _finalize(self, futs, imgs, labels, n_valid, valid=None):
-        for f in futs:
-            f.result()  # wait + propagate decode errors
+        # the parent-blocked-on-decode moment, thread edition (the
+        # process path's equivalent wait is spanned around collect)
+        with obs.get_tracer().span("collect"):
+            for f in futs:
+                f.result()  # wait + propagate decode errors
         return self._assemble(imgs, labels, n_valid, valid)
 
     def _assemble(self, imgs, labels, n_valid, valid=None):
@@ -391,9 +395,13 @@ class DataLoader:
                 self._issue_ahead_n += 1
                 slot, n_valid = pending.popleft()
                 out_size = self.batch_size if self.pad_final else n_valid
-                imgs, labels, lease = pipe.collect(
-                    slot, out_size, leased=self.leased
-                )
+                # the parent-blocked-on-spans moment (the ring's own
+                # io_wait_s counter measures the same wait cumulatively;
+                # the span places each wait on the step timeline)
+                with obs.get_tracer().span("collect"):
+                    imgs, labels, lease = pipe.collect(
+                        slot, out_size, leased=self.leased
+                    )
                 batch = self._assemble(imgs, labels, n_valid,
                                        valid=chunks[b][1])
                 if lease is not None:
@@ -605,26 +613,31 @@ class DevicePrefetcher:
         self._next = self._advance()
 
     def _advance(self):
+        tracer = obs.get_tracer()
         try:
             batch = next(self._it)
         except StopIteration:
             return None
         lease = batch.pop("_lease", None)
         if lease is None:
-            return self._put(batch)
+            with tracer.span("h2d"):
+                return self._put(batch)
         if self._copy is None:
             # CPU PJRT zero-copies suitably-shaped numpy buffers — the
             # device array then aliases the ring slot for its lifetime
             self._copy = jax.default_backend() == "cpu"
         if self._copy:
-            batch = {k: np.array(v) for k, v in batch.items()}
-            out = self._put(batch)
+            with tracer.span("h2d"):
+                batch = {k: np.array(v) for k, v in batch.items()}
+                out = self._put(batch)
             lease.release()
             return out
-        out = self._put(batch)
-        # the H2D read must finish before the slot may be overwritten;
-        # this wait overlaps the previous step's device compute
-        jax.block_until_ready(out)
+        with tracer.span("h2d"):
+            out = self._put(batch)
+            # the H2D read must finish before the slot may be
+            # overwritten; this wait overlaps the previous step's device
+            # compute
+            jax.block_until_ready(out)
         lease.release()
         return out
 

@@ -1,0 +1,215 @@
+"""Metrics registry: one namespace of counters/gauges/histograms, one
+sink fan-out.
+
+Before this module, every telemetry family had its own plumbing path:
+``feed_stats`` threaded through ``train_one_epoch`` into per-epoch stats,
+a hand-maintained ``writer.add_scalar`` ladder in ``fit``, and ad hoc
+console prints. The :class:`Registry` collapses the fan-out: producers
+publish named instruments, ``flush(step)`` snapshots every instrument
+once and emits the scalars to EVERY attached sink — TensorBoard
+(:class:`TensorBoardSink`), the per-host JSONL log (:class:`JsonlSink`),
+and the console (:class:`ConsoleSink`) — so adding a sink (or a metric)
+is one line, not three parallel edits.
+
+Instrument semantics:
+
+* ``Counter`` — monotonic; flush emits the cumulative value.
+* ``Gauge`` — last-set value.
+* ``Histogram`` — windowed observations; flush emits
+  ``<name>/p50|p90|max|mean|count`` and RESETS the window (per-epoch
+  distributions when flushed per epoch, like the train loop does).
+
+Stdlib-only (imported by the data layer — never JAX).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0):
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float):
+        self.value = float(v)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile over an already-sorted window."""
+    if not sorted_vals:
+        return 0.0
+    i = min(int(round(q * (len(sorted_vals) - 1))), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class Histogram:
+    __slots__ = ("_window",)
+
+    def __init__(self):
+        self._window: List[float] = []
+
+    def observe(self, v: float):
+        self._window.append(float(v))
+
+    def snapshot(self, reset: bool = False) -> Dict[str, float]:
+        vals = sorted(self._window)
+        if reset:
+            self._window = []
+        if not vals:
+            return {"count": 0.0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
+                    "max": 0.0}
+        return {
+            "count": float(len(vals)),
+            "mean": sum(vals) / len(vals),
+            "p50": _quantile(vals, 0.50),
+            "p90": _quantile(vals, 0.90),
+            "max": vals[-1],
+        }
+
+
+class Registry:
+    """Named instruments + sink fan-out. ``counter``/``gauge``/
+    ``histogram`` are get-or-create; re-registering a name as a
+    different kind raises (two producers silently sharing a name with
+    different semantics is a bug, not a merge)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._sinks: list = []
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls()
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def set_scalars(self, mapping: Dict[str, float]):
+        """Bulk-set gauges (the per-epoch stats publishing path)."""
+        for k, v in mapping.items():
+            self.gauge(k).set(v)
+
+    def add_sink(self, sink):
+        self._sinks.append(sink)
+
+    @property
+    def sinks(self):
+        return tuple(self._sinks)
+
+    def scalars(self, reset_histograms: bool = False) -> Dict[str, float]:
+        """One flat tag→value snapshot of every instrument (histograms
+        expand to ``name/p50`` etc.), deterministically ordered."""
+        out: Dict[str, float] = {}
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if isinstance(m, Histogram):
+                for stat, v in m.snapshot(reset=reset_histograms).items():
+                    out[f"{name}/{stat}"] = v
+            else:
+                out[name] = m.value
+        return out
+
+    def flush(self, step: int):
+        """Snapshot every instrument and fan the scalars out to every
+        sink (histogram windows reset — per-flush distributions)."""
+        scalars = self.scalars(reset_histograms=True)
+        for sink in self._sinks:
+            for tag, value in scalars.items():
+                sink.emit(tag, value, step)
+            end = getattr(sink, "flush_end", None)
+            if end is not None:
+                end(step)
+
+
+# ----------------------------------------------------------------- sinks ----
+
+
+class TensorBoardSink:
+    """Bridge to dptpu's zero-dependency event writer
+    (dptpu/utils/tensorboard.py) — or anything with ``add_scalar``."""
+
+    def __init__(self, writer):
+        self.writer = writer
+
+    def emit(self, tag: str, value: float, step: int):
+        self.writer.add_scalar(tag, value, step)
+
+
+class JsonlSink:
+    """One JSON line per flush: ``{"kind": "metrics", "step": N,
+    "wall_time": ..., "scalars": {...}}`` — the machine-readable epoch
+    record next to the span log."""
+
+    def __init__(self, path_or_file):
+        self._file = (
+            open(path_or_file, "a") if isinstance(path_or_file, str)
+            else path_or_file
+        )
+        self._pending: Dict[str, float] = {}
+
+    def emit(self, tag: str, value: float, step: int):
+        self._pending[tag] = value
+
+    def flush_end(self, step: int):
+        self._file.write(json.dumps({
+            "kind": "metrics", "step": step, "wall_time": time.time(),
+            "scalars": self._pending,
+        }) + "\n")
+        self._file.flush()
+        self._pending = {}
+
+    def close(self):
+        if not self._file.closed:
+            self._file.close()
+
+
+class ConsoleSink:
+    """Compact one-line console surface per flush, filtered by tag
+    prefix (default: the ``Obs/`` attribution family) — additive next to
+    the reference's contractual meter lines, never replacing them."""
+
+    def __init__(self, prefixes=("Obs/",), print_fn=print):
+        self.prefixes = tuple(prefixes)
+        self._print = print_fn
+        self._pending: Dict[str, float] = {}
+
+    def emit(self, tag: str, value: float, step: int):
+        if any(tag.startswith(p) for p in self.prefixes):
+            self._pending[tag] = value
+
+    def flush_end(self, step: int):
+        if self._pending:
+            parts = " ".join(
+                f"{t.split('/', 1)[1]}={v:.4g}"
+                for t, v in sorted(self._pending.items())
+            )
+            self._print(f"Obs[{step}]: {parts}")
+        self._pending = {}

@@ -173,10 +173,17 @@ class CheckpointManager:
     def save_step(self, state, *, epoch: int, step_in_epoch: int,
                   best_acc1: float = 0.0, sync: bool = False
                   ) -> Optional[str]:
+        from dptpu import obs
         from dptpu.train.checkpoint import save_checkpoint
 
         if not self.is_chief:
             return None
+        tracer = obs.get_tracer()
+        # span labels use the 0-based index of the step whose completion
+        # triggered the save (step_in_epoch counts steps CONSUMED) so
+        # the attribution report's per-step join lines up with the
+        # loop's data_wait/step/iter labels
+        span_step = step_in_epoch - 1
         filename = step_checkpoint_name(epoch, step_in_epoch)
         path = os.path.join(self.directory, filename)
         run_async = self.async_writer is not None and not sync
@@ -192,34 +199,53 @@ class CheckpointManager:
                 lambda x: x.copy() if hasattr(x, "copy") else x, state
             )
 
+        # span naming decides attribution: "ckpt_write" marks work on
+        # the WRITER thread (overlaps device compute → reported as
+        # async, outside the wall budget); the same closure running
+        # INLINE on a sync save stalls the step thread, so it records
+        # as plain "ckpt" (nested in the outer ckpt span — exclusive
+        # accounting keeps the sum exact)
+        write_span = "ckpt_write" if run_async else "ckpt"
+
         def _write():
-            save_checkpoint(
-                state,
-                epoch=epoch,
-                arch=self.arch,
-                best_acc1=best_acc1,
-                is_best=False,
-                directory=self.directory,
-                is_chief=True,
-                filename=filename,
-                step_in_epoch=step_in_epoch,
-                data_position=(
-                    step_in_epoch * self.batch_size
-                    if self.batch_size is not None else None
-                ),
-            )
-            if self.fault_plan is not None:
-                # fault hooks (ckpt_truncate@save=N) count ACTUAL writes
-                # in write order, so they ride the writer thread too
-                self.fault_plan.on_checkpoint_saved(path)
-            self._rotate()
+            with tracer.span(write_span, step=span_step):
+                save_checkpoint(
+                    state,
+                    epoch=epoch,
+                    arch=self.arch,
+                    best_acc1=best_acc1,
+                    is_best=False,
+                    directory=self.directory,
+                    is_chief=True,
+                    filename=filename,
+                    step_in_epoch=step_in_epoch,
+                    data_position=(
+                        step_in_epoch * self.batch_size
+                        if self.batch_size is not None else None
+                    ),
+                )
+                if self.fault_plan is not None:
+                    # fault hooks (ckpt_truncate@save=N) count ACTUAL
+                    # writes in write order, so they ride the writer
+                    # thread too
+                    self.fault_plan.on_checkpoint_saved(path)
+                self._rotate()
 
         if run_async:
-            self.async_writer.submit(_write)
+            # submit may BLOCK on writer backpressure (max_pending):
+            # that stall bills to the step thread, so span it
+            with tracer.span("ckpt", step=span_step):
+                self.async_writer.submit(_write)
+            obs.get_registry().gauge("Obs/ckpt_queue_depth").set(
+                self.async_writer.pending()
+            )
             return path
-        if self.async_writer is not None:
-            self.async_writer.flush()  # keep mtime order == save order
-        _write()
+        with tracer.span("ckpt", step=span_step):
+            if self.async_writer is not None:
+                # drain first: keep mtime order == save order (the
+                # flush stall is recorded as a ckpt_flush span)
+                self.async_writer.flush()
+            _write()
         return path
 
     def flush(self):

@@ -145,9 +145,21 @@ class AsyncCheckpointWriter:
             raise RuntimeError("AsyncCheckpointWriter is closed")
         self._q.put(fn)
 
+    def pending(self) -> int:
+        """Queued-but-unwritten saves (approximate; the observability
+        layer publishes this as ``Obs/ckpt_queue_depth`` — a depth that
+        sits at ``max_pending`` means the step loop is blocking on
+        checkpoint backpressure)."""
+        return self._q.qsize()
+
     def flush(self) -> None:
-        """Block until every queued save has hit disk."""
-        self._q.join()
+        """Block until every queued save has hit disk. The wait is
+        recorded as a ``ckpt_flush`` span — this is exactly the stall a
+        preemption/emergency save pays before its synchronous write."""
+        from dptpu import obs
+
+        with obs.get_tracer().span("ckpt_flush"):
+            self._q.join()
         self._raise_pending()
 
     def close(self) -> None:

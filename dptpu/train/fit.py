@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from dptpu import obs
 from dptpu.config import Config, derive
 from dptpu.data import (
     DataLoader,
@@ -156,6 +157,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     if cfg.ckpt_keep < 1:
         raise ValueError(f"--ckpt-keep {cfg.ckpt_keep} must be >= 1")
     fault_plan = FaultPlan.from_env()  # raises on a typo'd DPTPU_FAULT
+    obs_conf = obs.obs_knobs()  # DPTPU_OBS_* knobs fail fast too
     initialize_distributed(cfg)
     derived = derive(
         cfg,
@@ -684,6 +686,38 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     if profile_dir and derived.is_chief:
         jax.profiler.start_trace(profile_dir)
 
+    # --- observability (dptpu/obs): one tracer, one metrics registry,
+    # one sink fan-out. Step phases (data_wait/h2d/step/ckpt) record
+    # into the span ring; every per-epoch scalar publishes into the
+    # registry and flushes once to console + TB + JSONL; SIGUSR2 (or
+    # the DPTPU_OBS_TRIGGER sentinel) arms an in-flight device trace of
+    # the next DPTPU_OBS_TRACE_STEPS steps — no restart required.
+    tracer = obs.set_tracer(
+        obs.Tracer(capacity=obs_conf["ring"])
+        if obs_conf["enabled"] else obs.NullTracer()
+    )
+    registry = obs.set_registry(obs.Registry())
+    trace_sink = None
+    if obs_conf["dir"]:
+        # deliberately PER-HOST, not chief-only: the files are named
+        # obs-<host>.* and pod-wide straggler analysis needs every
+        # host's timeline (ROADMAP observability follow-on (a))
+        trace_sink = obs.TraceSink(obs_conf["dir"])
+        registry.add_sink(obs.JsonlSink(trace_sink.jsonl_file))
+    if writer is not None:
+        registry.add_sink(obs.TensorBoardSink(writer))
+    if verbose:
+        registry.add_sink(obs.ConsoleSink())
+    trigger = None
+    if obs_conf["enabled"]:
+        trigger = obs.ProfileTrigger(
+            obs_conf["dir"] or ckpt_dir,
+            trace_steps=obs_conf["trace_steps"],
+            tracer=tracer,
+            sentinel=obs_conf["trigger"],
+            verbose=verbose,
+        ).install()
+
     start_time = time.time()
     # resilience wiring (dptpu/resilience): a preemption guard turns
     # SIGTERM/SIGINT into a cooperative stop (finish the in-flight step,
@@ -736,6 +770,26 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
 
         return emergency_ok or guard.signum == _signal.SIGTERM
 
+    def _drain_spans():
+        # every drain of the shared tracer flows through here so an
+        # on-demand profile window straddling the drain point keeps its
+        # early spans (ProfileTrigger.absorb)
+        spans = tracer.drain()
+        if trigger is not None:
+            trigger.absorb(spans)
+        return spans
+
+    # per-step tick: the profiling trigger's state machine rides the
+    # same post-step hook as fault injection (one call, two consumers)
+    _fault_tick = fault_plan.on_step if fault_plan else None
+    if trigger is not None:
+        def obs_tick():
+            trigger.tick()
+            if _fault_tick is not None:
+                _fault_tick()
+    else:
+        obs_tick = _fault_tick
+
     result = {"history": [], "early_stopped": False, "training_time": None,
               "preempted": False}
     # last position at which `state` is known consistent — the boundary
@@ -787,6 +841,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 emergency["saved"] = True
                 return path
 
+            ep_t0 = time.time()
             state, train_stats = train_one_epoch(
                 state,
                 train_step,
@@ -800,11 +855,32 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 feed_stats=train_loader.feed_stats,
                 start_step=start_step,
                 should_stop=lambda: guard.requested,
-                on_step=fault_plan.on_step if fault_plan else None,
+                on_step=obs_tick,
                 ckpt_every=cfg.ckpt_steps,
                 ckpt_cb=_save_step if cfg.ckpt_steps else None,
                 emergency_cb=_emergency if emergency_ok else None,
             )
+            ep_wall = time.time() - ep_t0
+            # epoch attribution: drain this epoch's spans, account the
+            # wall time (data_wait / h2d / device / ckpt / other), and
+            # persist the timeline — the answer to "where did this
+            # epoch's time go" without a profiler session
+            ep_spans = _drain_spans()
+            obs_report = None
+            if tracer.enabled:
+                obs_report = obs.attribute_epoch(
+                    ep_spans, ep_wall, anomaly_x=obs_conf["anomaly"]
+                )
+                if verbose:
+                    print(obs.format_report(obs_report, epoch))
+            if trace_sink is not None:
+                trace_sink.add_spans(ep_spans)
+                if obs_report is not None:
+                    # the attribution block, machine-readable, in the
+                    # same per-host log as the spans it summarizes
+                    trace_sink.log_event(
+                        "epoch_report", {"epoch": epoch, **obs_report}
+                    )
             # update the fallback position the moment the state advances:
             # if anything below (the preemption save itself, a profiler
             # stop, validate) raises, the outer best-effort save must
@@ -851,70 +927,89 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             acc1 = val_stats["top1"]
             is_best = acc1 > best_acc1
             best_acc1 = max(acc1, best_acc1)
-            result["history"].append({"epoch": epoch, **{f"train_{k}": v for k, v in train_stats.items()}, **{f"val_{k}": v for k, v in val_stats.items()}})
-            boundary_path = save_checkpoint(
-                gathered,
-                epoch=epoch + 1,
-                arch=cfg.arch,
-                best_acc1=best_acc1,
-                is_best=is_best,
-                is_chief=derived.is_chief,
-                directory=ckpt_dir,
-            )
+            result["history"].append({
+                "epoch": epoch,
+                **{f"train_{k}": v for k, v in train_stats.items()},
+                **{f"val_{k}": v for k, v in val_stats.items()},
+                **({"obs": obs_report} if obs_report is not None else {}),
+            })
+            with tracer.span("ckpt"):
+                boundary_path = save_checkpoint(
+                    gathered,
+                    epoch=epoch + 1,
+                    arch=cfg.arch,
+                    best_acc1=best_acc1,
+                    is_best=is_best,
+                    is_chief=derived.is_chief,
+                    directory=ckpt_dir,
+                )
             if fault_plan is not None and boundary_path:
                 # boundary saves count toward ckpt_truncate@save=N too —
                 # the fault targets "the N-th checkpoint written", not
                 # only the rotated step files
                 fault_plan.on_checkpoint_saved(boundary_path)
-            if writer is not None:
-                # the reference's 11 scalars/epoch (imagenet_ddp_apex.py:280-290)
-                # plus dptpu's two feed-rate scalars (Time/data, Starvation)
-                bt = max(train_stats["batch_time"], 1e-9)
-                train_throughput = derived.global_batch_size / bt
-                val_bt = max(val_stats.get("batch_time", bt), 1e-9)
-                lr_now = train_stats["lr"]
-                writer.add_scalar("Throughput/train", train_throughput, epoch + 1)
-                writer.add_scalar(
-                    "Throughput/val", derived.global_batch_size / val_bt, epoch + 1
-                )
-                writer.add_scalar("Time/train", train_stats["batch_time"], epoch + 1)
-                writer.add_scalar("Time/val", val_bt, epoch + 1)
-                # feed-rate accounting: loader wait per step + the fraction of
-                # the epoch the chip spent starved for host data
-                writer.add_scalar("Time/data", train_stats["data_time"], epoch + 1)
-                writer.add_scalar(
-                    "Starvation/train", train_stats["starvation"], epoch + 1
-                )
-                if "cache_hit_rate" in train_stats:
-                    writer.add_scalar(
-                        "Cache/hit_rate", train_stats["cache_hit_rate"],
-                        epoch + 1,
-                    )
-                if "bytes_copied_per_batch" in train_stats:
-                    # the zero-copy contract on a dashboard: parent-side
-                    # copy-out bytes per batch (0 under leased slots)
-                    writer.add_scalar(
-                        "Feed/bytes_copied_per_batch",
-                        train_stats["bytes_copied_per_batch"], epoch + 1,
-                    )
-                # decode-ahead ring health: how full the slot ring ran,
-                # how many batches were pre-issued, straggler re-issues,
-                # and the parent's per-epoch span-wait (I/O wait) time
-                for tag, key in (
-                    ("Feed/ring_occupancy", "ring_occupancy"),
-                    ("Feed/issue_ahead_depth", "issue_ahead_depth"),
-                    ("Feed/straggler_reissues", "straggler_reissues"),
-                    ("Feed/io_wait_s", "io_wait_s"),
-                ):
-                    if key in train_stats:
-                        writer.add_scalar(tag, train_stats[key], epoch + 1)
-                writer.add_scalar("Loss/train", train_stats["loss"], epoch + 1)
-                writer.add_scalar("Loss/val", val_stats["loss"], epoch + 1)
-                writer.add_scalar("Top1/train", train_stats["top1"], epoch + 1)
-                writer.add_scalar("Top1/val", val_stats["top1"], epoch + 1)
-                writer.add_scalar("Top5/train", train_stats["top5"], epoch + 1)
-                writer.add_scalar("Top5/val", val_stats["top5"], epoch + 1)
-                writer.add_scalar("Lr", lr_now, epoch + 1)
+            # one registry, one fan-out (dptpu/obs): the reference's 11
+            # scalars/epoch (imagenet_ddp_apex.py:280-290), the feed
+            # telemetry, and the step-phase attribution all publish into
+            # the metrics registry and flush ONCE per epoch to every
+            # attached sink — TB writer (chief, apex), the per-host
+            # JSONL log (DPTPU_OBS_DIR), and the console Obs line —
+            # replacing the three parallel plumbing paths that used to
+            # carry them. Tags are unchanged: dashboards keep working.
+            bt = max(train_stats["batch_time"], 1e-9)
+            val_bt = max(val_stats.get("batch_time", bt), 1e-9)
+            scalars = {
+                "Throughput/train": derived.global_batch_size / bt,
+                "Throughput/val": derived.global_batch_size / val_bt,
+                "Time/train": train_stats["batch_time"],
+                "Time/val": val_bt,
+                # feed-rate accounting: loader wait per step + the
+                # fraction of the epoch the chip spent starved for data
+                "Time/data": train_stats["data_time"],
+                "Starvation/train": train_stats["starvation"],
+                "Loss/train": train_stats["loss"],
+                "Loss/val": val_stats["loss"],
+                "Top1/train": train_stats["top1"],
+                "Top1/val": val_stats["top1"],
+                "Top5/train": train_stats["top5"],
+                "Top5/val": val_stats["top5"],
+                "Lr": train_stats["lr"],
+            }
+            # decode-cache + zero-copy + decode-ahead ring telemetry
+            # (bytes_copied_per_batch = 0 is the zero-copy contract on
+            # a dashboard)
+            for tag, key in (
+                ("Cache/hit_rate", "cache_hit_rate"),
+                ("Feed/bytes_copied_per_batch", "bytes_copied_per_batch"),
+                ("Feed/ring_occupancy", "ring_occupancy"),
+                ("Feed/issue_ahead_depth", "issue_ahead_depth"),
+                ("Feed/straggler_reissues", "straggler_reissues"),
+                ("Feed/io_wait_s", "io_wait_s"),
+            ):
+                if key in train_stats:
+                    scalars[tag] = train_stats[key]
+            if obs_report is not None:
+                scalars.update({
+                    "Obs/data_wait_s": obs_report["data_wait_s"],
+                    "Obs/h2d_s": obs_report["h2d_s"],
+                    "Obs/device_s": obs_report["device_s"],
+                    "Obs/ckpt_s": obs_report["ckpt_s"],
+                    "Obs/other_s": obs_report["other_s"],
+                    "Obs/coverage": obs_report["coverage"],
+                    "Obs/step_p50_s": obs_report["step_p50_s"],
+                    "Obs/step_p90_s": obs_report["step_p90_s"],
+                    "Obs/step_max_s": obs_report["step_max_s"],
+                    "Obs/anomalous_steps":
+                        len(obs_report["anomalous_steps"]),
+                    "Obs/tracer_dropped": tracer.dropped,
+                })
+            registry.set_scalars(scalars)
+            registry.flush(epoch + 1)
+            # validation + boundary-save spans: persisted to the
+            # timeline, but never billed to the NEXT epoch's report
+            val_spans = _drain_spans()
+            if trace_sink is not None:
+                trace_sink.add_spans(val_spans)
             # --desired-acc early stop, fractional like the reference
             # (README --desired-acc 0.75 vs top1 in percent, imagenet_ddp.py:224-236);
             # values > 1 are read as percent directly (documented in --help)
@@ -967,21 +1062,55 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 pass
         raise
     finally:
+        # Teardown is loud on the NORMAL path and silent only while
+        # another error propagates (probe for an in-flight exception
+        # BEFORE any close attempt: inside an except clause
+        # sys.exc_info() would report the close error itself, never
+        # None). Order: profiler trigger (may need to stop a live jax
+        # trace), span/metric sinks, the TB writer — closing it HERE
+        # covers the exception/preemption paths too, so a preempted
+        # run's last-epoch scalars are never lost in a buffer — then
+        # the checkpoint writer thread (exception paths already saved
+        # synchronously, which drains the queue; a failed cadence write
+        # must fail the run, not vanish).
+        propagating = sys.exc_info()[0] is not None
+        teardown_errors = []
+        if trigger is not None:
+            try:
+                trigger.uninstall()
+            except Exception:
+                pass
+        try:
+            if trace_sink is not None:
+                trace_sink.add_spans(tracer.drain())
+                trace_sink.close()
+        except Exception as e:
+            teardown_errors.append(e)
+        obs.reset()
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception as e:
+                teardown_errors.append(e)
         if ckpt_writer is not None:
-            # exception paths already saved synchronously (which drains
-            # the queue); this close is loud on the NORMAL path — a
-            # failed cadence write must fail the run, not vanish.
-            # Probe for an in-flight exception BEFORE the close attempt:
-            # inside this except clause sys.exc_info() would report the
-            # close error itself, never None.
-            propagating = sys.exc_info()[0] is not None
+            # ALWAYS attempted, whatever the sinks above did: close() is
+            # the one place a failed async cadence write surfaces — an
+            # obs I/O error must never swallow a lost checkpoint
             try:
                 ckpt_writer.close()
-            except Exception:
-                if not propagating:
-                    raise
+            except Exception as e:
+                teardown_errors.append(e)
+        if teardown_errors:
+            # every failure gets at least a stderr line — raising can
+            # only surface one, and under a propagating exception none
+            for e in teardown_errors:
+                print(f"WARNING: teardown close failed: {e!r}",
+                      file=sys.stderr)
+            if not propagating:
+                # the LAST error is the checkpoint writer's when it
+                # failed — the one that must win the raise
+                raise teardown_errors[-1]
     if writer is not None:
-        writer.close()
         # final wall-clock report (imagenet_ddp_apex.py:292-300)
         elapsed = time.time() - start_time
         mins, secs = divmod(elapsed, 60)

@@ -21,6 +21,7 @@ from typing import Callable
 import jax
 import numpy as np
 
+from dptpu import obs
 from dptpu.utils.meters import AverageMeter, ProgressMeter, Summary
 
 
@@ -81,13 +82,29 @@ def train_one_epoch(
     last_lr = 0.0
     steps_done = start_step  # batches of THIS epoch consumed so far
     preempted = False
+    # step-phase spans (dptpu/obs): data_wait / step / fetch / ckpt plus
+    # a per-step "iter" envelope — the host half of the epoch
+    # attribution report. A NullTracer makes every record a no-op.
+    tracer = obs.get_tracer()
+    pc = time.perf_counter
     end = time.time()
+    it = iter(batches)
     i = -1
     try:
-        for i, batch in enumerate(batches):
+        while True:
+            t_iter0 = pc()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            i += 1
+            t_data = pc()
+            tracer.record("data_wait", t_iter0, t_data - t_iter0,
+                          step=steps_done)
             data_time.update(time.time() - end)
             n = int(np.prod(batch["labels"].shape))
             state, metrics = train_step(state, batch)
+            tracer.record("step", t_data, pc() - t_data, step=steps_done)
             steps_done += 1
             pending.append((metrics, n))
             if i % print_freq == 0:
@@ -102,11 +119,14 @@ def train_one_epoch(
                 lag = 0 if i == 0 else min(2, max(print_freq - 1, 0))
                 cut = max(len(pending) - lag, 0)
                 ready, pending = pending[:cut], pending[cut:]
+                t_fetch = pc()
                 for m, nb in jax.device_get([(p[0], p[1]) for p in ready]):
                     losses.update(float(m["loss"]), nb)
                     top1.update(float(m["top1"]), nb)
                     top5.update(float(m["top5"]), nb)
                     last_lr = float(m.get("lr", last_lr))
+                tracer.record("fetch", t_fetch, pc() - t_fetch,
+                              step=steps_done - 1)
                 batch_time.update(time.time() - end)
                 if verbose:
                     progress.display(i + start_step)
@@ -114,7 +134,19 @@ def train_one_epoch(
                 batch_time.update(time.time() - end)
             if ckpt_every and ckpt_cb is not None \
                     and steps_done % ckpt_every == 0:
+                t_ckpt = pc()
                 ckpt_cb(state, steps_done)
+                # steps_done already advanced: label the save with the
+                # 0-based index of the step whose completion triggered
+                # it, matching this iteration's data_wait/step/iter
+                # spans (the anomaly report joins phases by this label)
+                tracer.record("ckpt", t_ckpt, pc() - t_ckpt,
+                              step=steps_done - 1)
+            # the iter envelope closes BEFORE the on_step hook: a
+            # profile-trigger window that ends on this tick must see
+            # this step's iter span (the hook itself is microseconds)
+            tracer.record("iter", t_iter0, pc() - t_iter0,
+                          step=steps_done - 1)
             if on_step is not None:
                 on_step()
             if should_stop is not None and should_stop():
@@ -133,11 +165,16 @@ def train_one_epoch(
             except Exception:
                 pass
         raise
+    t_fetch = pc()
     for m, nb in jax.device_get(pending):
         losses.update(float(m["loss"]), nb)
         top1.update(float(m["top1"]), nb)
         top5.update(float(m["top5"]), nb)
         last_lr = float(m.get("lr", last_lr))
+    if pending:
+        # the epoch-tail sync: the last un-fetched steps drain here
+        tracer.record("fetch", t_fetch, pc() - t_fetch,
+                      step=steps_done - 1)
     stats = {
         "loss": losses.avg,
         "top1": top1.avg,
@@ -182,18 +219,34 @@ def validate(
     batch_time = AverageMeter("Time", ":6.3f", Summary.NONE)
     progress = ProgressMeter(num_batches, [batch_time], prefix="Test: ")
 
+    tracer = obs.get_tracer()
+    pc = time.perf_counter
     device_sums = []
     end = time.time()
-    for i, batch in enumerate(batches):
+    it = iter(batches)
+    i = -1
+    while True:
+        t0 = pc()
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        i += 1
+        t_data = pc()
+        tracer.record("data_wait", t0, t_data - t0, step=i)
         device_sums.append(eval_step(state, batch))
+        tracer.record("eval_step", t_data, pc() - t_data, step=i)
         batch_time.update(time.time() - end)
         end = time.time()
         if verbose and i % print_freq == 0:
             progress.display(i)
     totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
+    t_fetch = pc()
     for sums in jax.device_get(device_sums):
         for k in totals:
             totals[k] += float(sums[k])
+    if device_sums:
+        tracer.record("fetch", t_fetch, pc() - t_fetch, step=i)
     count = max(totals["count"], 1.0)
     stats = {
         "top1": 100.0 * totals["correct1"] / count,

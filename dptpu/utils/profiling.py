@@ -35,22 +35,67 @@ def parse_perfetto_trace(trace: dict, iters: int = 1) -> Tuple[float, Dict[str, 
     Returns ``(total_ms_per_iter, {op_name: ms_per_iter})``. Host-side
     tracks are excluded; the per-core duplicate tracks TPU traces carry
     are collapsed by taking the maximum-duration track per op name.
+
+    A trace with NO device-side events raises ``RuntimeError`` instead
+    of silently reporting ``(0, {})`` — a zero would read as "the device
+    did no work" when the real cause is almost always that no device
+    tracks matched: a host-only trace (backend whose PJRT plugin exports
+    no device timeline), a traced region that dispatched nothing, or a
+    track-naming scheme this parser doesn't know.
+
+    CPU-PJRT fallback: the CPU backend has no ``/device:*`` track — its
+    XLA ops execute on the ``tf_XLAEigen`` threadpool of the
+    ``/host:CPU`` process track, interleaved with Python tracemes and
+    compiler passes on OTHER threads of the same pid. When (and only
+    when) no real device track matched, op events from those Eigen
+    threads are used instead, so CPU-only runs still get a per-op table
+    (approximate: thread-parallel op time max-collapses to the busiest
+    thread, like the multi-replica rule).
     """
     events = trace.get("traceEvents", [])
-    pid_names = {}
+    pid_names, thread_names = {}, {}
     for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
             pid_names[e["pid"]] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", "")
+            )
     dev_pids = {
         p for p, n in pid_names.items()
         if ("TPU" in n or "/device" in n or "Device" in n) and "Host" not in n
     }
-    per_track: dict = collections.defaultdict(lambda: collections.Counter())
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
-            continue
-        per_track[(e["pid"], e.get("tid"))][e.get("name", "")] += (
-            e.get("dur", 0) / 1000.0
+
+    def _collect(want):
+        tracks: dict = collections.defaultdict(lambda: collections.Counter())
+        for e in events:
+            if e.get("ph") == "X" and want(e):
+                tracks[(e["pid"], e.get("tid"))][e.get("name", "")] += (
+                    e.get("dur", 0) / 1000.0
+                )
+        return tracks
+
+    per_track = _collect(lambda e: e.get("pid") in dev_pids)
+    if not per_track:
+        xla_cpu = {
+            (p, t) for (p, t), n in thread_names.items()
+            if str(pid_names.get(p, "")).startswith("/host:")
+            and str(n).startswith("tf_XLAEigen")
+        }
+        per_track = _collect(
+            lambda e: (e.get("pid"), e.get("tid")) in xla_cpu
+        )
+    if not per_track:
+        tracks = sorted(set(pid_names.values())) or ["<no process_name metadata>"]
+        raise RuntimeError(
+            "no device tracks matched in this trace — likely a host-only "
+            "trace (the backend exports no device timeline, e.g. an "
+            "un-relayed CPU run) or a traced region that dispatched no "
+            "device work. Process tracks seen: " + ", ".join(
+                repr(t) for t in tracks[:8]
+            )
         )
     by_op: collections.Counter = collections.Counter()
     for track in per_track.values():
@@ -69,6 +114,33 @@ def parse_perfetto_trace(trace: dict, iters: int = 1) -> Tuple[float, Dict[str, 
     if modules:
         return sum(modules.values()), ops
     return sum(ops.values()), ops
+
+
+def load_trace_dir(path: str) -> dict:
+    """Load + merge every perfetto export under ``path`` into one trace.
+
+    One ``*.trace.json.gz`` per host on multi-process runs. Perfetto
+    pids are only unique within a file, so namespace them per source
+    file before merging — otherwise host tracks from one file can
+    masquerade as device tracks of another. The parser's max-collapse
+    then yields the slowest replica's per-op time (the SPMD critical
+    path). Raises ``RuntimeError`` when no trace file exists under
+    ``path``.
+    """
+    paths = sorted(
+        glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                  recursive=True)
+    )
+    if not paths:
+        raise RuntimeError(f"no trace written under {path}")
+    merged = {"traceEvents": []}
+    for i, p in enumerate(paths):
+        with gzip.open(p, "rt") as f:
+            for e in json.load(f).get("traceEvents", []):
+                if "pid" in e:
+                    e = dict(e, pid=(i, e["pid"]))
+                merged["traceEvents"].append(e)
+    return merged
 
 
 def profile_device_time(fn: Callable, *args, iters: int = 6,
@@ -95,25 +167,7 @@ def profile_device_time(fn: Callable, *args, iters: int = 6,
             for _ in range(iters):
                 out = fn(*args)
             fence(out)
-        paths = sorted(
-            glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"),
-                      recursive=True)
-        )
-        if not paths:
-            raise RuntimeError(f"no trace written under {tmp}")
-        # one file per host on multi-process runs. Perfetto pids are only
-        # unique within a file, so namespace them per source file before
-        # merging — otherwise host tracks from one file can masquerade as
-        # device tracks of another. The parser's max-collapse then yields
-        # the slowest replica's per-op time (the SPMD critical path).
-        merged = {"traceEvents": []}
-        for i, path in enumerate(paths):
-            with gzip.open(path, "rt") as f:
-                for e in json.load(f).get("traceEvents", []):
-                    if "pid" in e:
-                        e = dict(e, pid=(i, e["pid"]))
-                    merged["traceEvents"].append(e)
-        return parse_perfetto_trace(merged, iters=iters)
+        return parse_perfetto_trace(load_trace_dir(tmp), iters=iters)
     finally:
         import shutil
 

@@ -14,6 +14,7 @@ Summary.Value fields {1: tag string, 2: simple_value float}.
 
 from __future__ import annotations
 
+import atexit
 import os
 import socket
 import struct
@@ -109,6 +110,14 @@ class SummaryWriter:
 
     ``comment`` builds the run directory exactly like the reference's
     ``runs/<datetime>_<host><comment>`` naming (imagenet_ddp_apex.py:155-159).
+
+    Durability contract (dptpu/resilience): every ``add_scalar`` flushes
+    the record to the OS, so the event file is parseable after a crash
+    at ANY record boundary — even SIGKILL mid-run loses nothing already
+    written. ``close`` is additionally registered with ``atexit`` so the
+    preemption path (SIGTERM guard → cooperative return, or an exception
+    that unwinds past the trainer) still closes the file even when no
+    caller reaches ``close()`` explicitly.
     """
 
     def __init__(self, log_dir: Optional[str] = None, comment: str = ""):
@@ -122,6 +131,7 @@ class SummaryWriter:
         fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
         self._file = open(os.path.join(log_dir, fname), "ab")
         self._write_record(_event(time.time(), file_version="brain.Event:2"))
+        atexit.register(self.close)
 
     def _write_record(self, data: bytes):
         header = struct.pack("<Q", len(data))
@@ -144,3 +154,6 @@ class SummaryWriter:
         if not self._file.closed:
             self._file.flush()
             self._file.close()
+        # bound methods compare equal, so this unregisters the handler
+        # installed in __init__ (idempotent close: later calls no-op)
+        atexit.unregister(self.close)

@@ -38,6 +38,11 @@ _FAST_MODULES = {
     "test_meters", "test_data", "test_tensorboard", "test_native",
     "test_cache", "test_shm_loader", "test_feed_knobs", "test_tv_template",
     "test_resilience", "test_shm_supervision", "test_fault_resume",
+    # observability tier (PR 5): obs unit tests are pure-fast; the
+    # obsbench smoke is the second deliberate fit()-driven exception —
+    # the overhead/coverage/trigger gates must hold in tier 1, and they
+    # can only be asserted through fit() (one subprocess, tiny preset)
+    "test_obs", "test_obs_knobs", "test_profiling", "test_obsbench_smoke",
 }
 
 

@@ -1,0 +1,92 @@
+"""The locked fail-fast env-knob contract, observability edition
+(tests/test_feed_knobs.py pattern): every explicitly-set-but-invalid
+``DPTPU_OBS_*`` value must raise with an actionable message."""
+
+import pytest
+
+from dptpu import obs
+
+_ALL = ("DPTPU_OBS", "DPTPU_OBS_RING", "DPTPU_OBS_DIR",
+        "DPTPU_OBS_TRACE_STEPS", "DPTPU_OBS_TRIGGER", "DPTPU_OBS_ANOMALY")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in _ALL:
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+def test_defaults():
+    assert obs.obs_knobs() == {
+        "enabled": True,
+        "ring": 65536,
+        "dir": None,
+        "trace_steps": 8,
+        "trigger": None,
+        "anomaly": 3.0,
+    }
+
+
+def test_explicit_values_land(monkeypatch):
+    monkeypatch.setenv("DPTPU_OBS", "0")
+    monkeypatch.setenv("DPTPU_OBS_RING", "4096")
+    monkeypatch.setenv("DPTPU_OBS_DIR", "/tmp/obs")
+    monkeypatch.setenv("DPTPU_OBS_TRACE_STEPS", "32")
+    monkeypatch.setenv("DPTPU_OBS_TRIGGER", "/tmp/armme")
+    monkeypatch.setenv("DPTPU_OBS_ANOMALY", "2.5")
+    assert obs.obs_knobs() == {
+        "enabled": False,
+        "ring": 4096,
+        "dir": "/tmp/obs",
+        "trace_steps": 32,
+        "trigger": "/tmp/armme",
+        "anomaly": 2.5,
+    }
+
+
+def test_obs_bool_junk_raises(monkeypatch):
+    monkeypatch.setenv("DPTPU_OBS", "maybe")
+    with pytest.raises(ValueError, match="DPTPU_OBS"):
+        obs.obs_knobs()
+
+
+def test_ring_floor_and_junk(monkeypatch):
+    for bad in ("0", "-1", "63"):
+        monkeypatch.setenv("DPTPU_OBS_RING", bad)
+        with pytest.raises(ValueError, match="DPTPU_OBS_RING"):
+            obs.obs_knobs()
+    monkeypatch.setenv("DPTPU_OBS_RING", "plenty")
+    with pytest.raises(ValueError, match="not an integer"):
+        obs.obs_knobs()
+    monkeypatch.setenv("DPTPU_OBS_RING", "64")  # the documented floor
+    assert obs.obs_knobs()["ring"] == 64
+
+
+def test_trace_steps_zero_negative_junk(monkeypatch):
+    for bad in ("0", "-4"):
+        monkeypatch.setenv("DPTPU_OBS_TRACE_STEPS", bad)
+        with pytest.raises(ValueError, match="DPTPU_OBS_TRACE_STEPS"):
+            obs.obs_knobs()
+    monkeypatch.setenv("DPTPU_OBS_TRACE_STEPS", "lots")
+    with pytest.raises(ValueError, match="not an integer"):
+        obs.obs_knobs()
+
+
+def test_anomaly_must_exceed_one(monkeypatch):
+    for bad in ("1", "1.0", "0.5", "-3"):
+        monkeypatch.setenv("DPTPU_OBS_ANOMALY", bad)
+        with pytest.raises(ValueError, match="DPTPU_OBS_ANOMALY"):
+            obs.obs_knobs()
+    monkeypatch.setenv("DPTPU_OBS_ANOMALY", "soon")
+    with pytest.raises(ValueError, match="not a number"):
+        obs.obs_knobs()
+
+
+def test_empty_strings_mean_unset(monkeypatch):
+    # the shared envknob contract: empty == absent, never an error
+    for k in _ALL:
+        monkeypatch.setenv(k, "")
+    assert obs.obs_knobs()["enabled"] is True
+    assert obs.obs_knobs()["dir"] is None
+    assert obs.obs_knobs()["trigger"] is None

@@ -2,6 +2,9 @@
 
 import glob
 import os
+import signal
+import subprocess
+import sys
 
 from dptpu.utils.tensorboard import SummaryWriter, _crc32c
 
@@ -33,6 +36,41 @@ def test_tensorboard_reads_our_events(tmp_path):
     for tag, points in scalars.items():
         got = [(e.step, round(e.value, 5)) for e in acc.Scalars(tag)]
         assert got == [(s, round(v, 5)) for s, v in points]
+
+
+def test_killed_writer_leaves_parseable_file(tmp_path):
+    """Preemption durability (dptpu/resilience): every add_scalar is
+    flushed to the OS, so a writer killed with SIGKILL — no atexit, no
+    close(), no SIGTERM grace — still leaves an event file stock
+    TensorBoard parses, containing every scalar written before death."""
+    logdir = str(tmp_path / "killed")
+    child = (
+        "import os, signal\n"
+        "from dptpu.utils.tensorboard import SummaryWriter\n"
+        f"w = SummaryWriter(log_dir={logdir!r})\n"
+        "for step in (1, 2, 3):\n"
+        "    w.add_scalar('Loss/train', 7.0 - step, step)\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+
+    from tensorboard.backend.event_processing import event_accumulator
+
+    acc = event_accumulator.EventAccumulator(logdir)
+    acc.Reload()
+    got = [(e.step, e.value) for e in acc.Scalars("Loss/train")]
+    assert got == [(1, 6.0), (2, 5.0), (3, 4.0)]
+
+
+def test_close_is_idempotent_and_atexit_safe(tmp_path):
+    # double close must not raise (the atexit hook runs after an
+    # explicit close on every normal path)
+    w = SummaryWriter(log_dir=str(tmp_path / "run2"))
+    w.add_scalar("Lr", 0.1, 1)
+    w.close()
+    w.close()
 
 
 def test_run_dir_naming_comment():
