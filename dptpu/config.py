@@ -66,6 +66,14 @@ class Config:
     # saves only, the reference's behavior (imagenet_ddp.py:216-222).
     ckpt_steps: int = 0
     ckpt_keep: int = 3
+    # large-batch training engine (dptpu extension, all variants):
+    # optimizer recipe, gradient-accumulation microbatching, warmup
+    # schedule and label smoothing (dptpu/ops/optimizers.py,
+    # dptpu/train/step.py). Defaults reproduce the reference exactly.
+    optimizer: str = "sgd"
+    accum_steps: int = 1
+    warmup_epochs: int = 0
+    label_smoothing: float = 0.0
     # distributed (ddp/nd; apex uses env:// exclusively)
     world_size: int = -1
     rank: int = -1
@@ -149,6 +157,31 @@ def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentPar
                         "one final mid-epoch save)")
     p.add_argument("--ckpt-keep", default=3, type=int, metavar="K",
                    help="how many rotated mid-epoch checkpoints to keep")
+    # dptpu large-batch extension (not reference flags): the
+    # ImageNet-in-minutes recipe — LARS/LAMB trust-ratio optimizers,
+    # emulated large batches via gradient accumulation, linear-warmup +
+    # cosine LR, label smoothing. Env twins: DPTPU_OPT / DPTPU_ACCUM /
+    # DPTPU_WARMUP_EPOCHS / DPTPU_LABEL_SMOOTH (env wins when set).
+    p.add_argument("--optimizer", default="sgd",
+                   choices=("sgd", "lars", "lamb"),
+                   help="update rule: reference SGD (default), or the "
+                        "large-batch layer-wise trust-ratio optimizers "
+                        "LARS/LAMB")
+    p.add_argument("--accum-steps", default=1, type=int, metavar="K",
+                   help="gradient-accumulation microbatches per step: "
+                        "each replica's batch splits into K fp32-"
+                        "accumulated microbatches before one optimizer "
+                        "update, so -b can exceed per-chip activation "
+                        "memory (the global batch is unchanged; K "
+                        "emulates a K x wider pod at microbatch b/K)")
+    p.add_argument("--warmup-epochs", default=0, type=int, metavar="N",
+                   help="N > 0 selects the large-batch schedule: linear "
+                        "LR warmup over N epochs then cosine decay "
+                        "(0 keeps the variant's reference schedule)")
+    p.add_argument("--label-smoothing", default=0.0, type=float,
+                   metavar="S",
+                   help="label-smoothing mass in [0, 1) for the training "
+                        "loss (0 = reference hard-target CE)")
     p.add_argument("-e", "--evaluate", dest="evaluate", action="store_true",
                    help="evaluate model on validation set")
     p.add_argument("--pretrained", dest="pretrained", action="store_true")

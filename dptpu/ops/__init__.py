@@ -1,5 +1,11 @@
 from dptpu.ops.loss import cross_entropy_loss
 from dptpu.ops.metrics import accuracy, topk_correct_fraction
+from dptpu.ops.optimizers import (
+    lamb,
+    lars,
+    scale_by_trust_ratio,
+    trust_ratio_stats,
+)
 from dptpu.ops.schedules import (
     step_decay_lr,
     warmup_step_decay_lr,
@@ -16,6 +22,10 @@ __all__ = [
     "cross_entropy_loss",
     "accuracy",
     "topk_correct_fraction",
+    "lamb",
+    "lars",
+    "scale_by_trust_ratio",
+    "trust_ratio_stats",
     "step_decay_lr",
     "warmup_step_decay_lr",
     "scale_lr_linear",

@@ -11,6 +11,14 @@ the update stays compiled):
   5-epoch linear warmup scaled by global step, applied per-step
   (imagenet_ddp_apex.py:527-543), on top of the linear-scaling rule
   ``lr0 · global_batch/256`` (imagenet_ddp_apex.py:161-162).
+
+One schedule is a dptpu extension (no reference analog): the
+large-batch recipe's linear-warmup + cosine-decay
+(:func:`make_warmup_cosine_schedule`) — the shape every
+ImageNet-in-minutes paper pairs with LARS/LAMB (arXiv:1711.04325 §5.1,
+arXiv:1904.00962 §5): LR ramps linearly from ~0 to the scaled peak over
+the warmup epochs (large-batch SGD diverges without it), then follows a
+half-cosine to ``end_lr``. Selected by ``--warmup-epochs N > 0``.
 """
 
 
@@ -55,6 +63,35 @@ def make_step_decay_schedule(base_lr, steps_per_epoch):
     def schedule(count):
         epoch = jnp.asarray(count) // steps_per_epoch
         return base_lr * jnp.power(0.1, (epoch // 30).astype(jnp.float32))
+
+    return schedule
+
+
+def make_warmup_cosine_schedule(base_lr, steps_per_epoch, total_epochs,
+                                warmup_epochs, end_lr=0.0):
+    """Traced large-batch schedule: linear warmup to ``base_lr`` over
+    ``warmup_epochs``, then cosine decay to ``end_lr`` over the rest.
+
+    Warmup is 1-based like the Apex schedule (the first step already
+    takes a nonzero LR — ``base_lr / warmup_steps`` — so no step is
+    wasted at exactly 0). A pure function of the global step count, so
+    resume lands on the exact LR like every other dptpu schedule.
+    """
+    import jax.numpy as jnp
+
+    warmup_steps = max(int(warmup_epochs * steps_per_epoch), 1)
+    total_steps = max(int(total_epochs * steps_per_epoch), warmup_steps + 1)
+
+    def schedule(count):
+        count = jnp.asarray(count).astype(jnp.float32)
+        warm = base_lr * (count + 1.0) / warmup_steps
+        frac = jnp.clip(
+            (count - warmup_steps) / (total_steps - warmup_steps), 0.0, 1.0
+        )
+        cos = end_lr + (base_lr - end_lr) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac)
+        )
+        return jnp.where(count < warmup_steps, warm, cos)
 
     return schedule
 

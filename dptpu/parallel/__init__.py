@@ -27,6 +27,8 @@ from dptpu.parallel.zero import (
     shard_zero1_state,
     zero1_sharded_fraction,
     zero1_state_specs,
+    zero1_sumsq_reduce,
+    zero1_update_shard_bytes,
 )
 
 __all__ = [
@@ -46,4 +48,6 @@ __all__ = [
     "vit_tp_specs",
     "zero1_sharded_fraction",
     "zero1_state_specs",
+    "zero1_sumsq_reduce",
+    "zero1_update_shard_bytes",
 ]

@@ -240,7 +240,8 @@ def shard_gspmd_state(state, mesh: Mesh, param_specs):
 
 def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
                           compute_dtype=jnp.float32, lr_schedule=None,
-                          seed: int = 0):
+                          seed: int = 0, accum_steps: int = 1,
+                          label_smoothing: float = 0.0):
     """Single-program train step partitioned by XLA.
 
     Same contract as ``make_train_step``: ``step(state, batch) ->
@@ -248,7 +249,11 @@ def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
     ``P("data")`` on entry), metrics are global scalars. The gradient
     all-reduce over ``data`` and the TP all-reduces over ``model`` are
     inserted by the SPMD partitioner — there is no collective in this
-    source.
+    source; that also covers the LARS/LAMB per-layer norms (global
+    reductions the partitioner lowers itself — no ``sumsq_reduce``
+    hook needed) and gradient accumulation (``accum_steps=k`` scans
+    GLOBAL microbatches of ``B/k``; BN stays global-per-microbatch,
+    the SyncBN semantics this path always has).
     """
     from dptpu.train.step import train_step_body, tpu_compiler_options
 
@@ -262,6 +267,7 @@ def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
         return train_step_body(
             state, batch, compute_dtype=compute_dtype,
             lr_schedule=lr_schedule, seed=seed, axis_size=1, on_mesh=False,
+            accum_steps=accum_steps, label_smoothing=label_smoothing,
         )
 
     st_shardings = state_shardings(state_template, mesh, param_specs)
@@ -270,7 +276,12 @@ def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
         "labels": NamedSharding(mesh, P(DATA_AXIS)),
     }
     rep = NamedSharding(mesh, P())
-    metric_shardings = {k: rep for k in ("loss", "top1", "top5", "lr")}
+    metric_keys = ["loss", "top1", "top5", "lr"]
+    from dptpu.ops.optimizers import trust_ratio_stats
+
+    if trust_ratio_stats(state_template.opt_state) is not None:
+        metric_keys += ["trust_min", "trust_mean", "trust_max"]
+    metric_shardings = {k: rep for k in metric_keys}
     return jax.jit(
         step,
         in_shardings=(st_shardings, batch_shardings),

@@ -42,11 +42,6 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-# Ulysses' all-to-all output sharding defeats the replication checker,
-# so this step needs the experimental entry point's check_rep=False
-# (same constraint as tests/test_sequence_parallel.py)
-from jax.experimental.shard_map import shard_map
-
 from dptpu.ops.loss import cross_entropy_loss
 from dptpu.ops.metrics import topk_correct_fraction
 from dptpu.parallel.mesh import DATA_AXIS
@@ -55,7 +50,7 @@ SEQ_AXIS = "seq"
 
 
 def make_seq_train_step(mesh: Mesh, seq_model, compute_dtype=jnp.float32,
-                        lr_schedule=None):
+                        lr_schedule=None, label_smoothing: float = 0.0):
     """Build the jitted sequence-parallel train step.
 
     ``seq_model`` is the ViT built with ``seq_axis_name=SEQ_AXIS`` and
@@ -65,7 +60,11 @@ def make_seq_train_step(mesh: Mesh, seq_model, compute_dtype=jnp.float32,
     ``step(state, batch) -> (state, metrics)`` with the batch sharded
     ``P(DATA_AXIS)`` (replicated over ``seq``) and replicated state.
     """
-    from dptpu.train.step import normalize_images, tpu_compiler_options
+    from dptpu.train.step import (
+        normalize_images,
+        shard_map_nocheck,
+        tpu_compiler_options,
+    )
 
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
@@ -80,7 +79,8 @@ def make_seq_train_step(mesh: Mesh, seq_model, compute_dtype=jnp.float32,
             logits = seq_model.apply(
                 {"params": params}, images, train=True
             )
-            local_loss = cross_entropy_loss(logits, labels)
+            local_loss = cross_entropy_loss(logits, labels,
+                                            label_smoothing)
             # global mean loss restricted to this member's local graph:
             # /n_data for the data-shard mean, /n_seq because every
             # sequence member recomputes the (identical) loss — the
@@ -116,12 +116,14 @@ def make_seq_train_step(mesh: Mesh, seq_model, compute_dtype=jnp.float32,
         }
         return new_state, metrics
 
-    sharded = shard_map(
+    # Ulysses' all-to-all output sharding defeats the replication
+    # checker, so this step runs with it off — via the same
+    # version-portable helper every other dptpu step uses
+    sharded = shard_map_nocheck(
         step,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), P(DATA_AXIS)),
         out_specs=(P(), P()),
-        check_rep=False,
     )
     return jax.jit(
         sharded, donate_argnums=0, compiler_options=tpu_compiler_options()

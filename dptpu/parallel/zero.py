@@ -21,9 +21,25 @@ weight-update sharding, the PAPERS.md retrieval — falls out of the same
   its shard's global-sum gradient. Total collective traffic
   (all-gather + reduce-scatter) equals DDP's all-reduce; XLA overlaps
   both with compute;
-* the SGD update (momentum, weight decay, LR) is elementwise, so each
-  device updates only its own shard — identical math to DDP, locked by
-  tests/test_zero1.py against the single-device big-batch step.
+* the ENTIRE optimizer update runs on the local shard — this is Xu et
+  al.'s weight-update sharding (arXiv:2004.13336) in full: SGD's chain
+  (momentum, weight decay, LR) is elementwise and needs nothing more;
+  the LARS/LAMB trust ratios (dptpu/ops/optimizers.py) need per-LAYER
+  norms, which each device completes from its shard-local partial
+  sums with ONE psum of a tiny ``[L, 2]`` stack (``zero1_sumsq_reduce``
+  below) — so optimizer FLOPs AND optimizer-state bytes scale 1/N with
+  DP width while the per-step collective bytes stay at DDP's
+  all-reduce volume plus those 2·L floats (at accum_steps=1; under
+  gradient accumulation the all-gather + reduce-scatter pair runs once
+  per MICROBATCH — K× the param bytes per step — where DDP's single
+  post-scan psum does not scale with K). Identical math to the
+  replicated update, locked by tests/test_zero1.py against the
+  single-device big-batch step;
+* the few leaves no dimension divides (tiny biases — a rounding error
+  of the bytes) stay replicated; their gradients take an explicit
+  ``lax.psum`` (the steps run ``check_rep=False``, so no implicit
+  collective exists to cover them — see
+  dptpu.train.step.shard_map_nocheck).
 
 Checkpointing/eval work unchanged: sharded arrays are still global
 jax.Arrays — ``np.asarray`` gathers for ``torch.save``-style
@@ -39,10 +55,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:  # jax ≥ 0.8 top-level name; experimental path kept as fallback
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 
 from dptpu.parallel.mesh import DATA_AXIS
 
@@ -81,16 +93,14 @@ def _sharded_axis(spec: P) -> int:
     return -1
 
 
-def zero1_sharded_fraction(state, mesh: Mesh) -> float:
-    """Fraction of params+opt_state BYTES that actually shard 1/N.
-
-    This is the feature's headline claim made measurable: ~1/N
-    persistent HBM per chip holds only if this is ≈1.0. Accepts a real
-    TrainState or a ``jax.eval_shape`` ShapeDtypeStruct tree (no
-    allocation needed)."""
+def _iter_state_bytes(state, mesh: Mesh):
+    """Yield ``(nbytes, is_sharded)`` for every params/opt_state leaf
+    under this state's ``zero1_state_specs`` — the ONE byte-accounting
+    walk behind ``zero1_sharded_fraction`` and
+    ``zero1_update_shard_bytes`` (a second copy of the zip would let
+    the telemetry silently diverge from the headline claim). Accepts a
+    real TrainState or a ``jax.eval_shape`` ShapeDtypeStruct tree."""
     specs = zero1_state_specs(state, mesh)
-    total = 0
-    sharded = 0
     for part in ("params", "opt_state"):
         leaves = jax.tree_util.tree_leaves(getattr(state, part))
         spec_leaves = jax.tree_util.tree_leaves(
@@ -100,9 +110,20 @@ def zero1_sharded_fraction(state, mesh: Mesh) -> float:
             nbytes = int(np.prod(leaf.shape) if leaf.shape else 1) * (
                 jnp.dtype(leaf.dtype).itemsize
             )
-            total += nbytes
-            if _sharded_axis(spec) >= 0:
-                sharded += nbytes
+            yield nbytes, _sharded_axis(spec) >= 0
+
+
+def zero1_sharded_fraction(state, mesh: Mesh) -> float:
+    """Fraction of params+opt_state BYTES that actually shard 1/N.
+
+    This is the feature's headline claim made measurable: ~1/N
+    persistent HBM per chip holds only if this is ≈1.0."""
+    total = 0
+    sharded = 0
+    for nbytes, is_sharded in _iter_state_bytes(state, mesh):
+        total += nbytes
+        if is_sharded:
+            sharded += nbytes
     return sharded / max(total, 1)
 
 
@@ -140,9 +161,61 @@ def gather_state(state, mesh: Mesh):
     )
 
 
+def zero1_sumsq_reduce(param_specs):
+    """Build the trust-ratio norm completer for the sharded update.
+
+    The trust-ratio transforms (dptpu/ops/optimizers.py) hand over a
+    params-structured tree of ``[sum(w²), sum(u²)]`` pairs computed on
+    the LOCAL shard. Sharded leaves' partials sum across the data axis;
+    replicated leaves' are already global (psum'ing them would count
+    each copy N times). ALL pairs stack into one ``[L, 2]`` array so the
+    completion is a single psum of ~2·L floats — the "one small psum"
+    that keeps the whole optimizer math shard-local (arXiv:2004.13336).
+    """
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    mask = np.array(
+        [1.0 if _sharded_axis(s) >= 0 else 0.0 for s in spec_leaves],
+        np.float32,
+    )[:, None]
+
+    def reduce(pairs_tree):
+        leaves, treedef = jax.tree_util.tree_flatten(pairs_tree)
+        if len(leaves) != len(spec_leaves):
+            raise ValueError(
+                f"trust-ratio pairs tree has {len(leaves)} leaves but the "
+                f"ZeRO-1 spec tree has {len(spec_leaves)} — the optimizer "
+                f"was built against a different param tree"
+            )
+        stacked = jnp.stack(leaves)
+        total = lax.psum(stacked, DATA_AXIS)  # the ONE small psum
+        completed = stacked * (1.0 - mask) + total * mask
+        return jax.tree_util.tree_unflatten(
+            treedef, [completed[i] for i in range(len(leaves))]
+        )
+
+    return reduce
+
+
+def zero1_update_shard_bytes(state, mesh: Mesh) -> int:
+    """Bytes of params + optimizer state ONE device reads/writes per
+    update under the sharded weight update (the ``Opt/update_shard_bytes``
+    gauge): sharded leaves count 1/N, replicated leaves in full. The
+    replicated-update baseline is the same sum with N = 1."""
+    n = int(mesh.shape[DATA_AXIS])
+    return sum(
+        nbytes // n if is_sharded else nbytes
+        for nbytes, is_sharded in _iter_state_bytes(state, mesh)
+    )
+
+
 def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
-                          lr_schedule=None, seed: int = 0):
-    """ZeRO-1 variant of ``dptpu.train.step.make_train_step``.
+                          lr_schedule=None, seed: int = 0,
+                          accum_steps: int = 1, label_smoothing: float = 0.0,
+                          tx_factory=None):
+    """ZeRO-1 / sharded-weight-update variant of
+    ``dptpu.train.step.make_train_step``.
 
     ``state_template`` fixes which leaves shard; it must be the SAME
     TrainState the returned step will receive (or share its
@@ -151,13 +224,47 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
     ``step(state, batch) -> (state, metrics)`` with the SAME contract and
     math as the DDP step; ``state`` must be in the ``shard_zero1_state``
     layout and comes back in it.
+
+    ``tx_factory(sumsq_reduce=...)`` rebuilds the optimizer with the
+    shard-aware trust-ratio norm completer injected (same state
+    structure, so the template's ``tx.init`` layout still matches); when
+    None the template's own ``tx`` runs — correct for any elementwise
+    chain (SGD), and for LARS/LAMB **only** via a factory.
+
+    ``accum_steps=k`` composes with the sharding: each microbatch's
+    gradient arrives reduce-scattered through the all-gather VJP, so the
+    fp32 accumulator is SHARD-sized (1/N of the model — accumulation
+    costs no replicated-gradient memory); params are re-gathered per
+    microbatch, the price of never materializing full optimizer state.
     """
-    from dptpu.train.step import train_step_body, tpu_compiler_options
+    from dptpu.train.step import (
+        shard_map_nocheck,
+        tpu_compiler_options,
+        train_step_body,
+    )
 
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
     axis_size = int(mesh.shape[DATA_AXIS])
     specs = zero1_state_specs(state_template, mesh)
+    tx = None
+    if tx_factory is not None:
+        tx = tx_factory(sumsq_reduce=zero1_sumsq_reduce(specs.params))
+    else:
+        from dptpu.ops.optimizers import trust_ratio_stats
+
+        if trust_ratio_stats(state_template.opt_state) is not None:
+            # without the factory the template's own tx would run with
+            # sumsq_reduce=None: every trust ratio computed from the
+            # 1/N shard-local norms, never completed across the axis —
+            # silently-wrong training that worsens with DP width
+            raise ValueError(
+                "state uses a trust-ratio optimizer (LARS/LAMB) but no "
+                "tx_factory was given — the sharded update would "
+                "compute per-layer norms from local shards only. Pass "
+                "tx_factory=partial(make_optimizer, momentum, wd, name) "
+                "so the norm completer can be injected."
+            )
 
     def gather_params(params):
         # all-gather (along whichever dim _leaf_spec chose) -> full
@@ -173,14 +280,26 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
 
         return jax.tree_util.tree_map(gather, params, specs.params)
 
+    def reduce_grads(grads):
+        # the all-gather VJP already reduced the sharded leaves; the
+        # replicated remainder (no divisible dim) needs its explicit
+        # cross-replica mean — under check_rep=False nothing is implicit
+        return jax.tree_util.tree_map(
+            lambda g, s: g if _sharded_axis(s) >= 0
+            else lax.psum(g, DATA_AXIS),
+            grads, specs.params,
+        )
+
     def step(state, batch):
         return train_step_body(
             state, batch, compute_dtype=compute_dtype,
             lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
             on_mesh=True, gather_params=gather_params,
+            reduce_grads=reduce_grads, tx=tx, accum_steps=accum_steps,
+            label_smoothing=label_smoothing,
         )
 
-    sharded = shard_map(
+    sharded = shard_map_nocheck(
         step,
         mesh=mesh,
         in_specs=(specs, P(DATA_AXIS)),

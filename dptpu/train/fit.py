@@ -39,6 +39,7 @@ from dptpu.data import (
 from dptpu.models import create_model
 from dptpu.ops.schedules import (
     make_step_decay_schedule,
+    make_warmup_cosine_schedule,
     make_warmup_step_decay_schedule,
 )
 from dptpu.parallel import (
@@ -146,6 +147,68 @@ def _feed_knobs() -> tuple:
     return workers_mode, cache_bytes or 0, cache_scope, leased
 
 
+def _opt_knobs(cfg: Config) -> tuple:
+    """The large-batch training-engine knobs, under the locked fail-fast
+    contract (every explicit-but-invalid value raises, pre-compile).
+
+    Returns ``(optimizer, accum_steps, warmup_epochs, label_smoothing)``.
+    Each ``DPTPU_*`` env twin OVERRIDES its CLI/config field when set —
+    same precedence as the feed knobs — and config values passed
+    programmatically get the identical validation as env values:
+
+    * ``DPTPU_OPT`` / ``--optimizer`` — ``sgd`` (reference), ``lars``,
+      ``lamb`` (dptpu/ops/optimizers.py);
+    * ``DPTPU_ACCUM`` / ``--accum-steps`` — microbatches per update,
+      >= 1 (1 = the exact unaccumulated step);
+    * ``DPTPU_WARMUP_EPOCHS`` / ``--warmup-epochs`` — > 0 selects the
+      linear-warmup + cosine schedule;
+    * ``DPTPU_LABEL_SMOOTH`` / ``--label-smoothing`` — in [0, 1).
+    """
+    from dptpu.envknob import env_choice, env_float, env_int
+
+    name = env_choice("DPTPU_OPT", ("sgd", "lars", "lamb"))
+    if name is None:
+        name = cfg.optimizer
+        if name not in ("sgd", "lars", "lamb"):
+            raise ValueError(
+                f"--optimizer {name!r} must be one of 'sgd'/'lars'/'lamb'"
+            )
+    accum = env_int("DPTPU_ACCUM", None)
+    if accum is None:
+        accum = cfg.accum_steps
+    if accum < 1:
+        raise ValueError(
+            f"DPTPU_ACCUM/--accum-steps {accum} must be >= 1 (1 disables "
+            f"gradient accumulation)"
+        )
+    warmup = env_int("DPTPU_WARMUP_EPOCHS", None)
+    if warmup is None:
+        warmup = cfg.warmup_epochs
+    if warmup < 0:
+        raise ValueError(
+            f"DPTPU_WARMUP_EPOCHS/--warmup-epochs {warmup} must be >= 0 "
+            f"(0 keeps the variant's reference schedule)"
+        )
+    if 0 < cfg.epochs <= warmup:
+        # make_warmup_cosine_schedule would clamp the cosine phase away
+        # and the whole run would sit below peak LR — silently-worse
+        # training, so it fails fast like every other invalid knob
+        raise ValueError(
+            f"DPTPU_WARMUP_EPOCHS/--warmup-epochs {warmup} must be < "
+            f"--epochs {cfg.epochs}: the run would end mid-warmup and "
+            f"never reach peak LR or the cosine decay"
+        )
+    smooth = env_float("DPTPU_LABEL_SMOOTH", None)
+    if smooth is None:
+        smooth = float(cfg.label_smoothing)
+    if not 0.0 <= smooth < 1.0:
+        raise ValueError(
+            f"DPTPU_LABEL_SMOOTH/--label-smoothing {smooth} must be in "
+            f"[0, 1) (0 disables smoothing)"
+        )
+    return name, int(accum), int(warmup), float(smooth)
+
+
 def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     """Train (or evaluate) per the config; returns a result dict."""
     # resilience knobs fail fast, before any compile (the locked contract)
@@ -158,6 +221,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         raise ValueError(f"--ckpt-keep {cfg.ckpt_keep} must be >= 1")
     fault_plan = FaultPlan.from_env()  # raises on a typo'd DPTPU_FAULT
     obs_conf = obs.obs_knobs()  # DPTPU_OBS_* knobs fail fast too
+    # large-batch engine knobs (optimizer / accumulation / warmup /
+    # smoothing) fail fast pre-compile under the same locked contract
+    opt_name, accum_steps, warmup_epochs, label_smooth = _opt_knobs(cfg)
     initialize_distributed(cfg)
     derived = derive(
         cfg,
@@ -167,6 +233,13 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     )
     if verbose is None:
         verbose = derived.is_chief
+    if not cfg.evaluate and derived.per_device_batch_size % accum_steps:
+        raise ValueError(
+            f"--accum-steps/DPTPU_ACCUM {accum_steps} does not divide the "
+            f"per-device batch of {derived.per_device_batch_size} — the "
+            f"microbatch is per-device-batch/K, so pick a divisor (or "
+            f"raise the batch size)"
+        )
 
     single_device = cfg.gpu is not None or jax.device_count() == 1
     # DPTPU_TP=N opens a model axis of size N on the mesh and routes
@@ -260,6 +333,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             f"DPTPU_SP={sp_n} does not divide the {jax.device_count()} "
             f"available devices — pick a divisor so the "
             f"{{data, seq}} mesh factors"
+        )
+    if use_sp and accum_steps > 1:
+        # fail fast rather than silently changing the effective batch:
+        # the sequence-parallel step has no microbatch scan (its token
+        # axis already divides the work another way)
+        raise ValueError(
+            f"--accum-steps/DPTPU_ACCUM {accum_steps} is not supported "
+            f"with DPTPU_SP (no microbatch scan in the sequence-parallel "
+            f"step) — drop one of the two"
         )
     if single_device:
         mesh = None
@@ -456,11 +538,36 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
            if _os_environ_flag("DPTPU_FUSED_STEM") and _resnet_family
            else {}),
     )
-    if cfg.variant == "apex":
-        schedule = make_warmup_step_decay_schedule(derived.scaled_lr, steps_per_epoch)
+    # LR schedule: --warmup-epochs > 0 selects the large-batch recipe's
+    # linear-warmup + cosine decay (every ImageNet-in-minutes paper's
+    # shape); otherwise each variant keeps its reference schedule.
+    # Accumulation does NOT rescale the LR: --accum-steps splits the
+    # global batch the user already chose into K microbatches (the
+    # optimizer still steps on exactly global_batch samples), so the
+    # apex linear-scaling rule's global_batch/256 factor already
+    # carries the full batch scale.
+    sched_lr = derived.scaled_lr
+    if warmup_epochs > 0:
+        schedule = make_warmup_cosine_schedule(
+            sched_lr, steps_per_epoch, cfg.epochs, warmup_epochs
+        )
+    elif cfg.variant == "apex":
+        schedule = make_warmup_step_decay_schedule(sched_lr, steps_per_epoch)
     else:
-        schedule = make_step_decay_schedule(derived.scaled_lr, steps_per_epoch)
-    tx = make_optimizer(cfg.momentum, cfg.weight_decay)
+        schedule = make_step_decay_schedule(sched_lr, steps_per_epoch)
+    tx = make_optimizer(cfg.momentum, cfg.weight_decay, name=opt_name)
+    if verbose and (opt_name != "sgd" or accum_steps > 1 or warmup_epochs
+                    or label_smooth):
+        print(
+            f"=> large-batch engine: optimizer={opt_name}, "
+            f"accum={accum_steps} (global batch "
+            f"{derived.global_batch_size} in microbatches of "
+            f"{derived.per_device_batch_size // accum_steps}/chip — "
+            f"emulates {accum_steps}x the DP width), "
+            f"warmup={warmup_epochs} epochs"
+            + (" (linear->cosine)" if warmup_epochs else "")
+            + f", label smoothing {label_smooth}"
+        )
     rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
     pretrained_vars = None
     if cfg.pretrained:
@@ -550,24 +657,36 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
               "shard the optimizer state over)")
     elif want_zero1 and cfg.evaluate and verbose:
         print("=> DPTPU_ZERO1 ignored: --evaluate does not train")
+    opt_shard_bytes = None
     if use_zero1:
-        # ZeRO-1 weight-update sharding: params + momentum live sharded
-        # over the data axis (~1/N persistent memory per chip), gradients
-        # arrive reduce-scattered through the all-gather VJP; update math
-        # identical to DDP (tests/test_zero1.py). Checkpoints and eval
-        # read the state transparently (sharded leaves are global
-        # jax.Arrays); eval/checkpoint gathers are per-epoch, not per-step.
+        # ZeRO-1 weight-update sharding: params + optimizer state live
+        # sharded over the data axis (~1/N persistent memory per chip),
+        # gradients arrive reduce-scattered through the all-gather VJP,
+        # and the ENTIRE update — including LARS/LAMB trust-ratio norms,
+        # completed shard-locally with one small psum via the injected
+        # tx_factory — runs on the local shard (arXiv:2004.13336;
+        # tests/test_zero1.py). Checkpoints and eval read the state
+        # transparently (sharded leaves are global jax.Arrays);
+        # eval/checkpoint gathers are per-epoch, not per-step.
         train_step = make_zero1_train_step(
             mesh, state, compute_dtype, lr_schedule=schedule,
             seed=cfg.seed if cfg.seed is not None else 0,
+            accum_steps=accum_steps, label_smoothing=label_smooth,
+            tx_factory=partial(
+                make_optimizer, cfg.momentum, cfg.weight_decay, opt_name
+            ),
         )
+        from dptpu.parallel import zero1_update_shard_bytes
+
+        opt_shard_bytes = zero1_update_shard_bytes(state, mesh)
         state = shard_zero1_state(state, mesh)
         # one all-gather per validation pass / checkpoint write (instead
         # of per eval step), and multi-host save stays fully addressable
         eval_view = lambda s: gather_state(s, mesh)  # noqa: E731
         eval_view_gathers = True  # collective: every host must join
         if verbose:
-            print("=> ZeRO-1 optimizer-state sharding over the data axis")
+            print("=> ZeRO-1 optimizer-state sharding over the data axis"
+                  f" (update touches {opt_shard_bytes / 1e6:.1f} MB/chip)")
     elif use_gspmd:
         # single-program GSPMD/pjit path: shardings annotated on jit, the
         # partitioner derives every collective (gradient all-reduce over
@@ -597,6 +716,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         train_step = make_gspmd_train_step(
             mesh, state, specs, compute_dtype, lr_schedule=schedule,
             seed=cfg.seed if cfg.seed is not None else 0,
+            accum_steps=accum_steps, label_smoothing=label_smooth,
         )
         state = shard_gspmd_state(state, mesh, specs)
         if rule == "dp_specs":
@@ -626,7 +746,8 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             seq_shard_tokens=True,
         )
         train_step = make_seq_train_step(
-            mesh, seq_model, compute_dtype, lr_schedule=schedule
+            mesh, seq_model, compute_dtype, lr_schedule=schedule,
+            label_smoothing=label_smooth,
         )
         eval_view = lambda s: s  # noqa: E731
         eval_view_gathers = False
@@ -640,6 +761,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         train_step = make_train_step(
             mesh, compute_dtype, lr_schedule=schedule,
             seed=cfg.seed if cfg.seed is not None else 0,
+            accum_steps=accum_steps, label_smoothing=label_smooth,
         )
         eval_view = lambda s: s  # noqa: E731
         eval_view_gathers = False
@@ -988,6 +1110,22 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             ):
                 if key in train_stats:
                     scalars[tag] = train_stats[key]
+            # large-batch engine telemetry (Opt/*): accumulation depth,
+            # the layer-wise trust-ratio spread (min/mean/max over
+            # layers, from the optimizer's own norms), and — under the
+            # sharded weight update — the bytes of optimizer state one
+            # chip actually touches per update (the 1/N claim on a
+            # dashboard)
+            scalars["Opt/accum_steps"] = accum_steps
+            for tag, key in (
+                ("Opt/trust_ratio_min", "trust_min"),
+                ("Opt/trust_ratio_mean", "trust_mean"),
+                ("Opt/trust_ratio_max", "trust_max"),
+            ):
+                if key in train_stats:
+                    scalars[tag] = train_stats[key]
+            if opt_shard_bytes is not None:
+                scalars["Opt/update_shard_bytes"] = opt_shard_bytes
             if obs_report is not None:
                 scalars.update({
                     "Obs/data_wait_s": obs_report["data_wait_s"],

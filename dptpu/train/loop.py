@@ -80,6 +80,10 @@ def train_one_epoch(
 
     pending = []  # (device_metrics, n) buffered until the next display
     last_lr = 0.0
+    # trust-ratio telemetry (LARS/LAMB steps only): last fetched values,
+    # reported like lr — absent keys mean a plain-SGD step
+    opt_last = {}
+    _TRUST_KEYS = ("trust_min", "trust_mean", "trust_max")
     steps_done = start_step  # batches of THIS epoch consumed so far
     preempted = False
     # step-phase spans (dptpu/obs): data_wait / step / fetch / ckpt plus
@@ -125,6 +129,9 @@ def train_one_epoch(
                     top1.update(float(m["top1"]), nb)
                     top5.update(float(m["top5"]), nb)
                     last_lr = float(m.get("lr", last_lr))
+                    for tk in _TRUST_KEYS:
+                        if tk in m:
+                            opt_last[tk] = float(m[tk])
                 tracer.record("fetch", t_fetch, pc() - t_fetch,
                               step=steps_done - 1)
                 batch_time.update(time.time() - end)
@@ -171,6 +178,9 @@ def train_one_epoch(
         top1.update(float(m["top1"]), nb)
         top5.update(float(m["top5"]), nb)
         last_lr = float(m.get("lr", last_lr))
+        for tk in _TRUST_KEYS:
+            if tk in m:
+                opt_last[tk] = float(m[tk])
     if pending:
         # the epoch-tail sync: the last un-fetched steps drain here
         tracer.record("fetch", t_fetch, pc() - t_fetch,
@@ -190,6 +200,7 @@ def train_one_epoch(
         "num_batches": i + 1,
         "steps_done": steps_done,
         "preempted": preempted,
+        **opt_last,
     }
     if feed_stats is not None:
         for k, v in feed_stats().items():

@@ -26,24 +26,55 @@ class TrainState(struct.PyTreeNode):
 
 
 def make_optimizer(
-    momentum: float = 0.9, weight_decay: float = 1e-4
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+    name: str = "sgd",
+    sumsq_reduce=None,
 ) -> optax.GradientTransformation:
-    """SGD direction with torch-exact update semantics (imagenet_ddp.py:133-135),
-    WITHOUT the learning rate.
+    """Build the lr-less optimizer direction chain.
 
-    torch.optim.SGD applies weight decay *into the gradient before* the
-    momentum accumulation (``g += wd·p``; ``buf = m·buf + g``;
-    ``p -= lr·buf``), and decays **every** parameter — conv/dense kernels,
-    biases, and BN scale/shift alike. This chain reproduces that ordering and
-    yields the un-scaled momentum buffer; the train step multiplies by
-    ``-lr(state.step)`` itself (torch's apply-lr-after-momentum), so the LR
-    schedule is a pure function of the checkpointed global step — restart at
-    ``--start-epoch N`` or resume lands on exactly the reference's epoch-N LR
-    instead of an optimizer-internal count that resets to 0.
+    ``name`` selects the recipe (``--optimizer`` / ``DPTPU_OPT``):
+
+    * ``sgd`` (default) — torch-exact SGD semantics
+      (imagenet_ddp.py:133-135): weight decay folds *into the gradient
+      before* the momentum accumulation (``g += wd·p``; ``buf = m·buf +
+      g``; ``p -= lr·buf``), and decays **every** parameter —
+      conv/dense kernels, biases, and BN scale/shift alike.
+    * ``lars`` / ``lamb`` — the large-batch layer-wise trust-ratio
+      optimizers (dptpu/ops/optimizers.py); these follow their papers'
+      skip list instead (no decay/trust on ndim<2 leaves). ``momentum``
+      feeds LARS's momentum; LAMB keeps its Adam betas.
+
+    Every chain yields the un-scaled direction; the train step
+    multiplies by ``-lr(state.step)`` itself (torch's
+    apply-lr-after-momentum), so the LR schedule is a pure function of
+    the checkpointed global step — restart at ``--start-epoch N`` or
+    resume lands on exactly the reference's epoch-N LR instead of an
+    optimizer-internal count that resets to 0.
+
+    ``sumsq_reduce`` threads the weight-update-sharding norm completer
+    into the trust-ratio stage (see dptpu/parallel/zero.py); ignored by
+    sgd, whose update is purely elementwise.
     """
-    return optax.chain(
-        optax.add_decayed_weights(weight_decay),
-        optax.trace(decay=momentum, nesterov=False),
+    if name == "sgd":
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.trace(decay=momentum, nesterov=False),
+        )
+    if name == "lars":
+        from dptpu.ops.optimizers import lars
+
+        return lars(
+            momentum=momentum,
+            weight_decay=weight_decay,
+            sumsq_reduce=sumsq_reduce,
+        )
+    if name == "lamb":
+        from dptpu.ops.optimizers import lamb
+
+        return lamb(weight_decay=weight_decay, sumsq_reduce=sumsq_reduce)
+    raise ValueError(
+        f"unknown optimizer {name!r}: expected 'sgd', 'lars' or 'lamb'"
     )
 
 

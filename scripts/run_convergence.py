@@ -106,12 +106,80 @@ def run_one(data_root: str, opt_level: str, epochs: int, seed: int):
     }
 
 
+def run_large_batch(data_root: str, epochs: int, seed: int):
+    """The ImageNet-in-minutes recipe at a proportionally-large batch:
+    LARS + linear-warmup->cosine + label smoothing at global batch 256
+    — 1/8 of the proxy train set per optimizer step (the ImageNet
+    analog is a ~164k batch), the regime where plain SGD needs the
+    trust ratio (PAPERS.md; dptpu/ops/optimizers.py) — microbatched x4
+    by gradient accumulation, so the run also exercises the
+    4-virtual-replica pod emulation end to end."""
+    from dptpu.config import Config
+    from dptpu.train import fit
+
+    cfg = Config(
+        data=data_root,
+        arch="resnet18",
+        epochs=epochs,
+        batch_size=256,
+        # apex linear scaling: peak LR = 4.0 * 256/256 = 4.0
+        # (accumulation does not rescale the LR — the global batch the
+        # rule reads is unchanged by the microbatch split)
+        lr=4.0,
+        momentum=0.9,
+        weight_decay=1e-4,
+        workers=8,
+        print_freq=50,
+        seed=seed,
+        variant="apex",
+        opt_level="O0",  # fp32: the recipe, not mixed precision, under test
+        dist_url="env://",
+        optimizer="lars",
+        accum_steps=4,
+        warmup_epochs=2,
+        label_smoothing=0.1,
+    )
+    t0 = time.time()
+    result = fit(cfg, image_size=32, verbose=False)
+    return {
+        "recipe": {
+            "optimizer": "lars",
+            "global_batch": 256,
+            "accum_steps": 4,
+            "microbatch": 64,
+            "batch_fraction_of_train_set": 256 / 2000.0,
+            "peak_lr": 4.0,
+            "warmup_epochs": 2,
+            "label_smoothing": 0.1,
+            "dtype": "float32",
+        },
+        "best_top1": result["best_acc1"],
+        "final_top1": result["history"][-1]["val_top1"],
+        "final_train_loss": result["history"][-1]["train_loss"],
+        "top1_curve": [round(h["val_top1"], 2) for h in result["history"]],
+        "trust_ratio_mean_last": result["history"][-1].get(
+            "train_trust_mean"
+        ),
+        "wall_seconds": round(time.time() - t0, 1),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--epochs", type=int, default=None,
+                    help="default: 15 (reference recipe) / 10 (large-batch)")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--out", default="CONVERGENCE.json")
     ap.add_argument("--keep-data", action="store_true")
+    ap.add_argument(
+        "--recipe", choices=("reference", "large-batch"),
+        default="reference",
+        help="reference = the fp32/bf16 pair (full rewrite of --out); "
+             "large-batch = ONE LARS+warmup+smoothing run at the "
+             "accumulation-emulated large batch, MERGED into --out "
+             "under 'large_batch' so the reference runs' provenance "
+             "(they may come from a real chip) is preserved",
+    )
     args = ap.parse_args()
 
     import atexit
@@ -128,6 +196,49 @@ def main():
         atexit.register(shutil.rmtree, ckpt_dir, ignore_errors=True)
     else:
         print(f"dataset: {tmp}  checkpoints: {ckpt_dir}")
+
+    if args.epochs is None:
+        args.epochs = 10 if args.recipe == "large-batch" else 15
+
+    if args.recipe == "large-batch":
+        lb_epochs = args.epochs
+        lb = run_large_batch(tmp, lb_epochs, args.seed)
+        lb["pass"] = lb["best_top1"] >= TOP1_BAR
+        lb["epochs"] = lb_epochs
+        lb["device"] = str(jax.devices()[0].device_kind)
+        lb["backend"] = jax.default_backend()
+        lb["top1_bar"] = TOP1_BAR
+        out = args.out if os.path.isabs(args.out) else os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            args.out,
+        )
+        report = {}
+        if os.path.exists(out):
+            with open(out) as f:
+                report = json.load(f)
+        report["large_batch"] = lb
+        # the artifact's headline pass stays the AND of every recorded
+        # gate: recompute the reference side from its per-gate fields
+        # (so a passing large-batch re-run clears a stale latched AND),
+        # but a legacy artifact without those fields keeps its recorded
+        # verdict — defaulting them to True would silently clear a
+        # reference failure that was never re-evaluated
+        if "pass" in report:
+            ref_pass = bool(report["pass"])
+            if "pass_top1_bar" in report or "pass_bf16_delta" in report:
+                ref_pass = (bool(report.get("pass_top1_bar", True))
+                            and bool(report.get("pass_bf16_delta", True)))
+            report["pass"] = ref_pass and lb["pass"]
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(json.dumps({k: lb[k] for k in (
+            "best_top1", "final_top1", "top1_bar", "pass", "backend",
+            "wall_seconds")}))
+        print(f"large-batch recipe best top1 {lb['best_top1']:.2f} "
+              f"(bar {TOP1_BAR}); merged into {out}")
+        if not lb["pass"]:
+            sys.exit(1)
+        return
 
     runs = [
         run_one(tmp, "O0", args.epochs, args.seed),

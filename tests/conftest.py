@@ -43,6 +43,11 @@ _FAST_MODULES = {
     # the overhead/coverage/trigger gates must hold in tier 1, and they
     # can only be asserted through fit() (one subprocess, tiny preset)
     "test_obs", "test_obs_knobs", "test_profiling", "test_obsbench_smoke",
+    # large-batch engine (PR 6): knob validation is pure; the recipe-math
+    # module is pure optax math plus TinyNet-sized jits (the
+    # test_fault_resume precedent) — the accumulation/trust-ratio locks
+    # must hold in tier 1
+    "test_opt_knobs", "test_optimizers",
 }
 
 

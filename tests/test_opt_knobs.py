@@ -1,0 +1,145 @@
+"""The locked fail-fast env-knob contract, large-batch-engine edition
+(mirrors tests/test_feed_knobs.py): every explicitly-set-but-invalid
+value of DPTPU_OPT / DPTPU_ACCUM / DPTPU_WARMUP_EPOCHS /
+DPTPU_LABEL_SMOOTH raises pre-compile with an actionable message, the
+env twin overrides the CLI/config field, and config values passed
+programmatically get the identical validation as env values.
+"""
+
+import pytest
+
+from dptpu.config import Config
+from dptpu.train.fit import _opt_knobs
+
+_KNOBS = ("DPTPU_OPT", "DPTPU_ACCUM", "DPTPU_WARMUP_EPOCHS",
+          "DPTPU_LABEL_SMOOTH")
+
+
+def _cfg(**kw):
+    return Config(data="synthetic:16", **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_defaults_reproduce_reference(monkeypatch):
+    # unset env + default config = the reference recipe exactly
+    assert _opt_knobs(_cfg()) == ("sgd", 1, 0, 0.0)
+
+
+def test_env_overrides_config(monkeypatch):
+    cfg = _cfg(optimizer="sgd", accum_steps=1, warmup_epochs=0,
+                 label_smoothing=0.0)
+    monkeypatch.setenv("DPTPU_OPT", "lars")
+    monkeypatch.setenv("DPTPU_ACCUM", "4")
+    monkeypatch.setenv("DPTPU_WARMUP_EPOCHS", "5")
+    monkeypatch.setenv("DPTPU_LABEL_SMOOTH", "0.1")
+    assert _opt_knobs(cfg) == ("lars", 4, 5, 0.1)
+
+
+def test_config_values_pass_through():
+    cfg = _cfg(optimizer="lamb", accum_steps=2, warmup_epochs=3,
+                 label_smoothing=0.2)
+    assert _opt_knobs(cfg) == ("lamb", 2, 3, 0.2)
+
+
+def test_opt_choice_validated_env_and_config(monkeypatch):
+    monkeypatch.setenv("DPTPU_OPT", "adam")
+    with pytest.raises(ValueError, match="DPTPU_OPT"):
+        _opt_knobs(_cfg())
+    monkeypatch.delenv("DPTPU_OPT")
+    with pytest.raises(ValueError, match="--optimizer"):
+        _opt_knobs(_cfg(optimizer="adam"))
+
+
+def test_accum_zero_negative_garbage_raise(monkeypatch):
+    for bad in ("0", "-2"):
+        monkeypatch.setenv("DPTPU_ACCUM", bad)
+        with pytest.raises(ValueError, match="DPTPU_ACCUM"):
+            _opt_knobs(_cfg())
+    monkeypatch.setenv("DPTPU_ACCUM", "many")
+    with pytest.raises(ValueError, match="not an integer"):
+        _opt_knobs(_cfg())
+    monkeypatch.delenv("DPTPU_ACCUM")
+    # config field hits the same validation as the env twin
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="accum-steps"):
+            _opt_knobs(_cfg(accum_steps=bad))
+    # =1 is the documented off value, never an error
+    assert _opt_knobs(_cfg(accum_steps=1))[1] == 1
+
+
+def test_warmup_negative_and_garbage_raise(monkeypatch):
+    monkeypatch.setenv("DPTPU_WARMUP_EPOCHS", "-1")
+    with pytest.raises(ValueError, match="DPTPU_WARMUP_EPOCHS"):
+        _opt_knobs(_cfg())
+    monkeypatch.setenv("DPTPU_WARMUP_EPOCHS", "soon")
+    with pytest.raises(ValueError, match="not an integer"):
+        _opt_knobs(_cfg())
+    monkeypatch.delenv("DPTPU_WARMUP_EPOCHS")
+    with pytest.raises(ValueError, match="warmup-epochs"):
+        _opt_knobs(_cfg(warmup_epochs=-3))
+    # explicit 0 keeps the reference schedule — valid
+    assert _opt_knobs(_cfg(warmup_epochs=0))[2] == 0
+
+
+def test_warmup_swallowing_the_whole_run_raises(monkeypatch):
+    """warmup >= epochs would clamp the cosine phase away and the run
+    would never reach peak LR — silently-worse training, so it fails
+    fast like every other invalid knob (env twin and config field)."""
+    with pytest.raises(ValueError, match="mid-warmup"):
+        _opt_knobs(_cfg(epochs=10, warmup_epochs=10))
+    with pytest.raises(ValueError, match="mid-warmup"):
+        _opt_knobs(_cfg(epochs=10, warmup_epochs=25))
+    monkeypatch.setenv("DPTPU_WARMUP_EPOCHS", "90")
+    with pytest.raises(ValueError, match="mid-warmup"):
+        _opt_knobs(_cfg(epochs=90))
+    # the last warmup-compatible value is valid
+    monkeypatch.delenv("DPTPU_WARMUP_EPOCHS")
+    assert _opt_knobs(_cfg(epochs=10, warmup_epochs=9))[2] == 9
+
+
+def test_label_smooth_range_and_garbage_raise(monkeypatch):
+    for bad in ("1.0", "-0.1", "2"):
+        monkeypatch.setenv("DPTPU_LABEL_SMOOTH", bad)
+        with pytest.raises(ValueError, match="DPTPU_LABEL_SMOOTH"):
+            _opt_knobs(_cfg())
+    monkeypatch.setenv("DPTPU_LABEL_SMOOTH", "a little")
+    with pytest.raises(ValueError, match="not a number"):
+        _opt_knobs(_cfg())
+    monkeypatch.delenv("DPTPU_LABEL_SMOOTH")
+    with pytest.raises(ValueError, match="label-smoothing"):
+        _opt_knobs(_cfg(label_smoothing=1.0))
+    # boundary: 0 valid (off), 0.999... valid
+    assert _opt_knobs(_cfg(label_smoothing=0.0))[3] == 0.0
+    assert _opt_knobs(_cfg(label_smoothing=0.9))[3] == 0.9
+
+
+def test_fit_rejects_accum_not_dividing_per_device_batch(monkeypatch):
+    """fit() fails fast (pre-mesh, pre-compile) when accum does not
+    divide the per-device batch — the microbatch must be integral."""
+    from dptpu.train.fit import fit
+
+    # 8 fake devices (conftest): batch 8 -> per-device 1; accum 3 can't
+    # divide it
+    cfg = Config(data="synthetic:16", arch="resnet18", batch_size=8,
+                 epochs=1, accum_steps=3)
+    with pytest.raises(ValueError, match="does not divide"):
+        fit(cfg, image_size=32, verbose=False)
+
+
+def test_cli_flags_parse_into_config():
+    from dptpu.config import parse_config
+
+    cfg = parse_config([
+        "--optimizer", "lamb", "--accum-steps", "4",
+        "--warmup-epochs", "5", "--label-smoothing", "0.1", "data",
+    ], variant="ddp")
+    assert (cfg.optimizer, cfg.accum_steps, cfg.warmup_epochs,
+            cfg.label_smoothing) == ("lamb", 4, 5, 0.1)
+    # the parser rejects an unknown optimizer at the CLI boundary too
+    with pytest.raises(SystemExit):
+        parse_config(["--optimizer", "adam", "data"], variant="ddp")

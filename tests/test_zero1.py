@@ -196,3 +196,169 @@ def test_gather_state_rereplicates(eight_devices):
     k = g.params["Dense_0"]["kernel"]
     assert k.sharding.spec == P()
     assert k.addressable_shards[0].data.shape == (16, 32)
+
+
+# --- sharded weight update (arXiv:2004.13336): the ENTIRE optimizer
+# math — LARS/LAMB trust ratios included — runs on the local shard,
+# completed by one small psum. Parity vs the replicated update. ---
+
+
+def _trust_state(name, bn_axis_name=None):
+    tx = make_optimizer(momentum=0.9, weight_decay=1e-4, name=name)
+    return create_train_state(
+        jax.random.PRNGKey(0), TinyDense(bn_axis_name=bn_axis_name), tx,
+        input_shape=(1, 8, 8, 3),
+    )
+
+
+def _tx_factory(name):
+    from functools import partial
+
+    return partial(make_optimizer, 0.9, 1e-4, name)
+
+
+def test_zero1_sharded_lars_matches_replicated_8dev(eight_devices):
+    """20 steps of the sharded LARS update (trust-ratio norms completed
+    from shard-local partials with one [L,2] psum) == 20 steps of the
+    replicated single-device LARS step, within the NUMERICS tolerance.
+    Locks optimizer-math-on-1/N against the full-math baseline."""
+    mesh = make_mesh(eight_devices, {"data": 8})
+    state0 = _trust_state("lars", bn_axis_name="data")
+    z_state = shard_zero1_state(state0, mesh)
+    z_step = make_zero1_train_step(
+        mesh, state0, tx_factory=_tx_factory("lars")
+    )
+    ref_state = _trust_state("lars")
+    ref_step = make_train_step()
+    for i in range(20):
+        batch = _batch(seed=i)
+        ref_state, ref_m = ref_step(ref_state, batch)
+        z_state, z_m = z_step(z_state, shard_host_batch(batch, mesh))
+        np.testing.assert_allclose(
+            float(z_m["loss"]), float(ref_m["loss"]), rtol=1e-5, atol=1e-6
+        )
+        # the trust-ratio telemetry from the sharded norms equals the
+        # replicated optimizer's (same completed sums)
+        np.testing.assert_allclose(
+            float(z_m["trust_mean"]), float(ref_m["trust_mean"]),
+            rtol=1e-5, atol=1e-7,
+        )
+    for part in ("params", "opt_state"):
+        for zp, rp in zip(
+            jax.tree_util.tree_leaves(getattr(z_state, part)),
+            jax.tree_util.tree_leaves(getattr(ref_state, part)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(zp), np.asarray(rp), rtol=1e-4, atol=1e-5
+            )
+
+
+def test_zero1_sharded_lamb_matches_replicated_2dev(eight_devices):
+    """Same lock for LAMB on the minimal 2-device mesh (the smallest
+    geometry where sharded != replicated): Adam moments live 1/N and the
+    unit trust ratio completes from partial sums."""
+    mesh = make_mesh(eight_devices[:2], {"data": 2})
+    state0 = _trust_state("lamb", bn_axis_name="data")
+    z_state = shard_zero1_state(state0, mesh)
+    z_step = make_zero1_train_step(
+        mesh, state0, tx_factory=_tx_factory("lamb")
+    )
+    ref_state = _trust_state("lamb")
+    ref_step = make_train_step()
+    for i in range(10):
+        batch = _batch(seed=i)
+        ref_state, ref_m = ref_step(ref_state, batch)
+        z_state, z_m = z_step(z_state, shard_host_batch(batch, mesh))
+    np.testing.assert_allclose(
+        float(z_m["loss"]), float(ref_m["loss"]), rtol=1e-5, atol=1e-6
+    )
+    for zp, rp in zip(
+        jax.tree_util.tree_leaves(z_state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(zp), np.asarray(rp), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_zero1_accum_composes_with_sharding(eight_devices):
+    """accum=2 under ZeRO-1 == accum=2 under DDP (same virtual-replica
+    math; the fp32 accumulator is shard-sized but the completed update
+    is identical)."""
+    mesh = make_mesh(eight_devices, {"data": 8})
+    state0 = _trust_state("lars", bn_axis_name="data")
+    z_state = shard_zero1_state(state0, mesh)
+    z_step = make_zero1_train_step(
+        mesh, state0, accum_steps=2, tx_factory=_tx_factory("lars")
+    )
+    d_state = _trust_state("lars", bn_axis_name="data")
+    d_step = make_train_step(mesh=mesh, accum_steps=2)
+    for i in range(5):
+        batch = _batch(n=32, seed=i)
+        sharded = shard_host_batch(batch, mesh)
+        z_state, z_m = z_step(z_state, sharded)
+        d_state, d_m = d_step(d_state, sharded)
+    np.testing.assert_allclose(
+        float(z_m["loss"]), float(d_m["loss"]), rtol=1e-5, atol=1e-6
+    )
+    for zp, dp in zip(
+        jax.tree_util.tree_leaves(z_state.params),
+        jax.tree_util.tree_leaves(jax.device_get(d_state.params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(zp), np.asarray(dp), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_zero1_sumsq_reduce_completes_only_sharded_leaves(eight_devices):
+    """The one-small-psum completer: sharded leaves' [sum(w²), sum(u²)]
+    partials sum across the axis; replicated leaves pass through (a psum
+    would count each copy N times)."""
+    import jax.numpy as jnp
+
+    from dptpu.parallel.zero import zero1_sumsq_reduce
+    from dptpu.train.step import shard_map_nocheck
+
+    mesh = make_mesh(eight_devices, {"data": 8})
+    param_specs = {"b": P(), "w": P(None, "data")}
+    reduce = zero1_sumsq_reduce(param_specs)
+
+    def body():
+        pairs = {"b": jnp.asarray([3.0, 5.0]), "w": jnp.asarray([1.0, 2.0])}
+        return reduce(pairs)
+
+    out = jax.jit(shard_map_nocheck(
+        body, mesh=mesh, in_specs=(), out_specs={"b": P(), "w": P()}
+    ))()
+    np.testing.assert_allclose(np.asarray(out["w"]), [8.0, 16.0])  # psum'd
+    np.testing.assert_allclose(np.asarray(out["b"]), [3.0, 5.0])  # untouched
+
+    # structure mismatch (optimizer built against another param tree)
+    # fails loudly, not with a silently wrong stack alignment
+    import pytest
+
+    with pytest.raises(ValueError, match="different param tree"):
+        reduce({"w": jnp.zeros(2)})
+
+
+def test_zero1_update_shard_bytes_scales_inverse_n(eight_devices):
+    """The Opt/update_shard_bytes gauge: per-update optimizer bytes on
+    one chip are ~1/N of the replicated total (replicated remainder is a
+    rounding error for TinyDense: only the 10-wide head bias resists 8)."""
+    from dptpu.parallel import zero1_update_shard_bytes
+
+    state = _state()
+    mesh8 = make_mesh(eight_devices, {"data": 8})
+    mesh2 = make_mesh(eight_devices[:2], {"data": 2})
+    total = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(
+            (state.params, state.opt_state)
+        )
+        if hasattr(leaf, "size")
+    )
+    b8 = zero1_update_shard_bytes(state, mesh8)
+    b2 = zero1_update_shard_bytes(state, mesh2)
+    assert total / 8 <= b8 <= total / 8 * 1.15
+    assert total / 2 <= b2 <= total / 2 * 1.05
+    assert b8 < b2 < total
