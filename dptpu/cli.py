@@ -1,5 +1,9 @@
 """Console entry points — shared by the repo-root reference-parity scripts
-and the installed ``dptpu-*`` commands (pyproject [project.scripts])."""
+and the installed ``dptpu-*`` commands (pyproject [project.scripts]).
+
+Besides the three reference-parity trainers, the ``dptpu`` multi-command
+(``main``) fronts the dptpu-native subsystems; its first subcommand is
+``dptpu serve`` — the batched inference engine (dptpu/serve)."""
 
 from dptpu.config import parse_config
 from dptpu.train import fit
@@ -48,6 +52,149 @@ def main_apex(argv=None):
 # exits 1 — which would break the exit-0 contract graceful preemption
 # (and every successful run) depends on. The repo-root scripts and tests
 # keep calling the result-returning ``main_*`` directly.
+
+def build_serve_parser():
+    """``dptpu serve`` flags. Env twins (``DPTPU_SERVE_*``) WIN over
+    these when set — the precedence every dptpu knob follows — and BOTH
+    sources go through the same ``serve_knobs`` validation, so a typo'd
+    value fails fast pre-compile whichever way it arrived."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dptpu serve",
+        description="batched inference: AOT bucket compilation + "
+                    "continuous dynamic batching (dptpu/serve)",
+    )
+    p.add_argument("-a", "--arch", default="resnet50", metavar="ARCH",
+                   help="registry architecture (dptpu.models.model_names)")
+    p.add_argument("--buckets", default=None, metavar="N,N,...",
+                   help="AOT batch-size bucket ladder (default 1,4,16,64; "
+                        "env DPTPU_SERVE_BUCKETS)")
+    p.add_argument("--max-delay-ms", type=float, default=None,
+                   help="batcher coalescing budget (default 5.0; env "
+                        "DPTPU_SERVE_MAX_DELAY_MS)")
+    p.add_argument("--placement", default=None,
+                   help="auto | replicated | tp (default auto; env "
+                        "DPTPU_SERVE_PLACEMENT)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="staging-ring depth (default 4; env "
+                        "DPTPU_SERVE_SLOTS)")
+    p.add_argument("--pretrained", action="store_true",
+                   help="load converted torchvision weights "
+                        "($DPTPU_PRETRAINED_DIR/<arch>.npz)")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--selftest", type=int, default=0, metavar="N",
+                   help="serve N synthetic requests through the full "
+                        "bytes->batcher->engine path and exit (no "
+                        "listener) — the smoke/readiness mode")
+    return p
+
+
+def serve_args_to_knobs(args):
+    """CLI namespace -> validated ServeKnobs + arch check (the fail-fast
+    moment: every bad knob OR unknown name raises BEFORE any compile)."""
+    from dptpu.models import model_names
+    from dptpu.serve import serve_knobs
+
+    knobs = serve_knobs(
+        buckets=args.buckets, max_delay_ms=args.max_delay_ms,
+        placement=args.placement, slots=args.slots,
+    )
+    if args.arch not in model_names():
+        raise ValueError(
+            f"--arch={args.arch!r} is not a registry architecture "
+            f"(e.g. {', '.join(model_names()[:4])}, ...; full list: "
+            f"python -c 'from dptpu.models import model_names; "
+            f"print(model_names())')"
+        )
+    return knobs
+
+
+def main_serve(argv=None):
+    """``dptpu serve``: load a model, AOT-compile the bucket ladder,
+    and serve — over HTTP, or ``--selftest N`` synthetic requests."""
+    args = build_serve_parser().parse_args(argv)
+    knobs = serve_args_to_knobs(args)  # fail fast, pre-jax-compile
+
+    from dptpu.serve import DynamicBatcher, ServeEngine
+
+    engine = ServeEngine(
+        args.arch, buckets=knobs.buckets, placement=knobs.placement,
+        num_classes=args.num_classes, image_size=args.image_size,
+        pretrained=args.pretrained, verbose=True,
+    )
+    batcher = DynamicBatcher(
+        engine, max_delay_ms=knobs.max_delay_ms, slots=knobs.slots
+    )
+    try:
+        if args.selftest:
+            return _serve_selftest(batcher, args.selftest)
+        print(
+            f"=> dptpu serve: {args.arch} ({engine.placement}, buckets "
+            f"{list(knobs.buckets)}) on http://{args.host}:{args.port} "
+            f"— POST /predict, GET /healthz, GET /metrics"
+        )
+        from dptpu.serve.http import serve_forever
+
+        serve_forever(batcher, args.host, args.port)
+        return {"served": batcher.stats()["completed"]}
+    finally:
+        batcher.close()
+
+
+def _serve_selftest(batcher, n: int):
+    """Readiness probe: N JPEG-encoded synthetic requests through the
+    full bytes -> preprocess -> staging -> bucket -> logits path."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    size = batcher.engine.image_size
+    futs = []
+    for _ in range(n):
+        buf = io.BytesIO()
+        Image.fromarray(
+            rng.randint(0, 256, (size, size, 3), dtype=np.uint8)
+        ).save(buf, format="JPEG")
+        futs.append(batcher.submit_bytes(buf.getvalue()))
+    for f in futs:
+        f.result(timeout=120.0)
+    stats = batcher.stats()
+    print(
+        f"serve selftest: {stats['completed']} ok, {stats['failed']} "
+        f"failed, p50 {stats['latency_ms']['p50']:.1f}ms p99 "
+        f"{stats['latency_ms']['p99']:.1f}ms, buckets "
+        f"{stats['bucket_counts']}"
+    )
+    return stats
+
+
+def main(argv=None):
+    """The ``dptpu`` multi-command: ``dptpu serve [...]``."""
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: dptpu <subcommand> [args]\n\nsubcommands:\n"
+              "  serve   batched inference engine (dptpu/serve)")
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "serve":
+        return main_serve(rest)
+    raise SystemExit(
+        f"dptpu: unknown subcommand {cmd!r} (available: serve)"
+    )
+
+
+def console_main(argv=None) -> int:
+    out = main(argv)
+    return out if isinstance(out, int) else 0
+
 
 def console_ddp(argv=None) -> int:
     main_ddp(argv)

@@ -72,12 +72,13 @@ class Histogram:
             self._window = []
         if not vals:
             return {"count": 0.0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
-                    "max": 0.0}
+                    "p99": 0.0, "max": 0.0}
         return {
             "count": float(len(vals)),
             "mean": sum(vals) / len(vals),
             "p50": _quantile(vals, 0.50),
             "p90": _quantile(vals, 0.90),
+            "p99": _quantile(vals, 0.99),
             "max": vals[-1],
         }
 

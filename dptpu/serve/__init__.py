@@ -1,0 +1,68 @@
+"""dptpu.serve — batched inference under heavy traffic.
+
+The production half of the repo (ROADMAP open item 1): everything the
+training stack built — the 20+ model registry, the logit-exact
+torchvision weight converter, the TP sharding rules, the zero-copy
+leased-slot protocol and ``dptpu/obs`` — consumed by one serving
+vertical:
+
+* :class:`ServeEngine` (engine.py) — AOT-compiles the eval forward at a
+  fixed ladder of batch-size buckets (``DPTPU_SERVE_BUCKETS``) so no
+  request ever hits a compile stall; padded-bucket execution is
+  logit-IDENTICAL to the single-request path (the >= 2 execution floor,
+  see engine.py); weights are generation-tagged and hot-swappable
+  without dropping in-flight requests; placement per family is
+  replicated or Megatron-TP (``DPTPU_SERVE_PLACEMENT``).
+* :class:`DynamicBatcher` (batcher.py) — continuous dynamic batching:
+  queued requests coalesce into the largest ready bucket under a
+  latency budget (``DPTPU_SERVE_MAX_DELAY_MS``), staged zero-copy in a
+  leased /dev/shm slot ring (staging.py — the feed's ``SlotLease``
+  handoff, serving edition).
+* :func:`preprocess_bytes` (preprocess.py) — request bytes -> the
+  pixel-exact validation pixels (``ValTransform``), bit-identical to
+  the training/eval pipeline's val path.
+* knob contract (knobs.py) + stdlib HTTP listener (http.py) behind the
+  ``dptpu serve`` CLI subcommand (dptpu/cli.py).
+
+Benchmarked by ``scripts/run_servebench.py`` (SERVEBENCH.json: p50/p99
+latency x offered-load curves closed- and open-loop, saturation
+throughput, bucket utilization, a tail-latency gate), smoked in tier 1
+by tests/test_servebench_smoke.py.
+
+This package root is import-light: engine/batcher (and jax with them)
+load lazily so the CLI can validate knobs — and the conftest leak guard
+can police staging segments — without touching a backend.
+"""
+
+from dptpu.serve.knobs import (
+    DEFAULT_BUCKETS,
+    DEFAULT_MAX_DELAY_MS,
+    DEFAULT_SLOTS,
+    PLACEMENTS,
+    ServeKnobs,
+    parse_buckets,
+    serve_knobs,
+)
+from dptpu.serve.preprocess import preprocess_array, preprocess_bytes
+
+__all__ = [
+    "DEFAULT_BUCKETS", "DEFAULT_MAX_DELAY_MS", "DEFAULT_SLOTS",
+    "PLACEMENTS", "ServeKnobs", "parse_buckets", "serve_knobs",
+    "preprocess_bytes", "preprocess_array",
+    "ServeEngine", "DynamicBatcher", "ServeFuture", "ServeError",
+    "resolve_placement",
+]
+
+
+def __getattr__(name):
+    # lazy jax-side surface: ServeEngine/DynamicBatcher import the
+    # backend; the knob/preprocess surface above stays import-light
+    if name in ("ServeEngine", "resolve_placement"):
+        from dptpu.serve import engine
+
+        return getattr(engine, name)
+    if name in ("DynamicBatcher", "ServeFuture", "ServeError"):
+        from dptpu.serve import batcher
+
+        return getattr(batcher, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

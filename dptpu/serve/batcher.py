@@ -1,0 +1,386 @@
+"""Continuous dynamic batching over the leased staging ring.
+
+Protocol (ISSUE 7 tentpole (b)): requests preprocess IN PLACE into rows
+of the one OPEN staging slot; a dispatcher thread closes the slot —
+coalescing everything queued into the smallest bucket that holds it —
+the moment either (a) the largest bucket fills, or (b) the oldest
+request has waited ``max_delay_ms`` (the latency budget; ``0`` =
+dispatch every ready request immediately). While the engine runs one
+batch, new arrivals fill the NEXT slot — batching is continuous, the
+device never waits on a fixed batch boundary, and a full ring (every
+slot leased to an in-flight batch) is the backpressure signal that
+blocks ``submit`` rather than growing an unbounded queue.
+
+Per-request phase spans land on the ``dptpu/obs`` tracer
+(``serve_queue`` — waiting for a staging row; ``serve_preprocess`` —
+bytes -> pixels; ``serve_batch_wait`` — coalescing delay;
+``serve_device`` — the engine records the compiled call;
+``serve_postprocess`` — logit slicing/top-k) and the serve metrics
+group on the registry (``Serve/qps``, ``Serve/p99_ms``,
+``Serve/bucket_occupancy``, ``Serve/padding_waste``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from dptpu import obs
+from dptpu.data.transforms import ValTransform
+from dptpu.serve.preprocess import preprocess_bytes, val_resize_for
+from dptpu.serve.staging import StagingRing
+
+
+class ServeError(RuntimeError):
+    pass
+
+
+class ServeFuture:
+    """One request's pending result; ``result()`` blocks for the logits
+    (float32 ``[num_classes]``) or re-raises the request's failure."""
+
+    __slots__ = ("_event", "_logits", "_error", "generation", "timings")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._logits = None
+        self._error = None
+        self.generation = None  # weight generation that served it
+        self.timings: Dict[str, float] = {}
+
+    def _fulfill(self, logits, generation, timings):
+        self._logits = logits
+        self.generation = generation
+        self.timings = timings
+        self._event.set()
+
+    def _fail(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._logits
+
+
+class _Request:
+    __slots__ = ("future", "row", "t_arrive", "t_ready", "ready", "failed")
+
+    def __init__(self, row: int, t_arrive: float):
+        self.future = ServeFuture()
+        self.row = row
+        self.t_arrive = t_arrive
+        self.t_ready = 0.0
+        self.ready = False
+        self.failed = False
+
+
+class DynamicBatcher:
+    """Continuous batcher over one :class:`ServeEngine`."""
+
+    def __init__(self, engine, max_delay_ms: float = 5.0, slots: int = 4):
+        if max_delay_ms < 0:
+            raise ValueError(
+                f"max_delay_ms={max_delay_ms} must be >= 0"
+            )
+        self.engine = engine
+        self.max_delay_s = max_delay_ms / 1e3
+        item = (engine.image_size, engine.image_size, 3)
+        # rows per slot = the LARGEST bucket's executable size, so pad
+        # rows live in the same leased memory the device reads — but
+        # ADMISSION is capped at the largest bucket itself: the floor
+        # rows beyond it (a 1-only ladder executes at 2) are pad-only
+        # and must never be claimed by a request bucket_for() can't place
+        self._ring = StagingRing(
+            slots, engine.exec_batch(engine.max_bucket), item
+        )
+        self._admit_max = engine.max_bucket
+        self._tf = ValTransform(
+            engine.image_size, val_resize_for(engine.image_size)
+        )
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._open: Optional[int] = None  # slot being filled
+        self._open_reqs: list = []
+        self._closing = False
+        # telemetry (guarded by _lock)
+        self._completed = 0
+        self._failed = 0
+        self._batches = 0
+        self._batch_seq = 0  # dispatch order tag (futures' batch_index)
+        self._bucket_counts: Dict[int, int] = {}
+        self._occupancy_sum = 0.0
+        self._pad_rows = 0
+        self._exec_rows = 0
+        self._latency = obs.get_registry().histogram("Serve/latency_ms")
+        self._qps_t0 = time.perf_counter()
+        self._qps_n0 = 0
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="dptpu-serve-dispatch",
+            daemon=True,
+        )
+        self._dispatcher.start()
+
+    # -- submission -----------------------------------------------------
+
+    def submit_bytes(self, data: bytes) -> ServeFuture:
+        """Enqueue one request from image bytes (any PIL-decodable
+        container); decoding runs on the CALLER's thread — submission
+        concurrency is the preprocessing parallelism."""
+        return self._submit(data, None)
+
+    def submit_array(self, img: np.ndarray) -> ServeFuture:
+        """Enqueue an already-preprocessed uint8 HWC tensor (the bench's
+        decode-free path; shape must match the engine's image size)."""
+        return self._submit(None, img)
+
+    def _submit(self, data, img) -> ServeFuture:
+        tracer = obs.get_tracer()
+        t_arrive = time.perf_counter()
+        with self._cond:
+            while True:
+                if self._closing:
+                    raise ServeError("batcher is shut down")
+                if self._open is None:
+                    slot = self._ring.acquire()
+                    if slot is not None:
+                        self._open = slot
+                        self._open_reqs = []
+                if self._open is not None and \
+                        len(self._open_reqs) < self._admit_max:
+                    break
+                # every slot leased or the open one is full mid-decode:
+                # backpressure (bounded ring), not an unbounded queue
+                self._cond.wait(0.05)
+            req = _Request(len(self._open_reqs), t_arrive)
+            self._open_reqs.append(req)
+            slot = self._open
+            row_view = self._ring.rows(slot)[req.row]
+        t_row = time.perf_counter()
+        if t_row - t_arrive > 1e-4:
+            tracer.record("serve_queue", t_arrive, t_row - t_arrive)
+        try:
+            if img is not None:
+                if img.shape != row_view.shape:
+                    raise ValueError(
+                        f"request tensor {img.shape} != engine item "
+                        f"shape {row_view.shape} (preprocess first?)"
+                    )
+                np.copyto(row_view, img)
+            else:
+                preprocess_bytes(
+                    data, size=self.engine.image_size, out=row_view,
+                    _transform=self._tf,
+                )
+        except Exception as e:
+            with self._cond:
+                req.failed = True
+                req.ready = True
+                req.t_ready = time.perf_counter()
+                self._failed += 1
+                self._cond.notify_all()
+            req.future._fail(
+                e if isinstance(e, ValueError) else ServeError(str(e))
+            )
+            return req.future
+        t_done = time.perf_counter()
+        tracer.record("serve_preprocess", t_row, t_done - t_row)
+        with self._cond:
+            req.ready = True
+            req.t_ready = t_done
+            self._cond.notify_all()
+        return req.future
+
+    # -- dispatch -------------------------------------------------------
+
+    def _dispatchable_locked(self):
+        """(slot, reqs) when the open slot should dispatch NOW, else
+        (None, deadline): all claimed rows decoded AND (bucket_max full
+        OR oldest ready request older than the budget OR closing)."""
+        reqs = self._open_reqs
+        if self._open is None or not reqs:
+            return None, None
+        if not all(r.ready for r in reqs):
+            return None, None  # a decode is mid-flight; it will notify
+        oldest = min(r.t_ready for r in reqs if not r.failed) \
+            if any(not r.failed for r in reqs) else 0.0
+        full = len(reqs) == self._admit_max
+        deadline = oldest + self.max_delay_s
+        if full or self._closing or time.perf_counter() >= deadline \
+                or all(r.failed for r in reqs):
+            slot = self._open
+            self._open = None
+            self._open_reqs = []
+            return (slot, reqs), None
+        return None, deadline
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                while True:
+                    batch, deadline = self._dispatchable_locked()
+                    if batch is not None:
+                        break
+                    if self._closing and self._open is None:
+                        return
+                    timeout = None if deadline is None else \
+                        max(0.0, deadline - time.perf_counter())
+                    self._cond.wait(timeout)
+            slot, reqs = batch
+            try:
+                self._run_batch(slot, reqs)
+            except Exception as e:
+                # the dispatcher thread must survive ANY batch failure:
+                # a dead dispatcher strands the open slot and blocks
+                # every future submit on backpressure forever.
+                # _run_batch already fails futures + releases the lease
+                # on engine errors; this guard covers the pre-lease
+                # paths (the slot is still FILLING there, so abandon
+                # frees it; post-lease it is a checked no-op)
+                err = ServeError(f"dispatch failed: {e}")
+                for r in reqs:
+                    if not r.future.done():
+                        r.future._fail(err)
+                self._ring.abandon(slot)
+                with self._lock:
+                    self._failed += sum(1 for r in reqs if not r.failed)
+            finally:
+                with self._cond:
+                    self._cond.notify_all()
+
+    def _run_batch(self, slot: int, reqs):
+        tracer = obs.get_tracer()
+        live = [r for r in reqs if not r.failed]
+        if not live:
+            self._ring.abandon(slot)
+            return
+        n = len(reqs)  # failed rows still occupy their claimed rows
+        engine = self.engine
+        bucket = engine.bucket_for(n)
+        nexec = engine.exec_batch(bucket)
+        rows = self._ring.rows(slot)
+        for pad in range(n, nexec):
+            np.copyto(rows[pad], rows[live[0].row])
+        lease = self._ring.lease(slot)
+        gen = engine.acquire_generation()
+        with self._lock:
+            self._batch_seq += 1
+            batch_index = self._batch_seq
+        t_disp = time.perf_counter()
+        try:
+            logits = engine.run_bucket(bucket, rows[:nexec], n, gen=gen)
+        except Exception as e:
+            lease.release()
+            engine.release_generation(gen)
+            err = ServeError(f"bucket {bucket} execution failed: {e}")
+            for r in live:
+                r.future._fail(err)
+            with self._lock:
+                self._failed += len(live)
+            return
+        # logits are materialized on the host => the device is done
+        # reading the slot: the lease may recycle it under new requests
+        lease.release()
+        engine.release_generation(gen)
+        t_post = time.perf_counter()
+        for r in live:
+            tracer.record("serve_batch_wait", r.t_ready,
+                          t_disp - r.t_ready)
+            out = np.array(logits[r.row])
+            r.future._fulfill(out, gen, {
+                "queue_ms": (r.t_ready - r.t_arrive) * 1e3,
+                "batch_wait_ms": (t_disp - r.t_ready) * 1e3,
+                "device_ms": (t_post - t_disp) * 1e3,
+                "total_ms": (t_post - r.t_arrive) * 1e3,
+                "bucket": bucket,
+                "batch_index": batch_index,
+            })
+            self._latency.observe((t_post - r.t_arrive) * 1e3)
+        tracer.record("serve_postprocess", t_post,
+                      time.perf_counter() - t_post)
+        reg = obs.get_registry()
+        occupancy = n / bucket
+        waste = (nexec - n) / nexec
+        reg.gauge("Serve/bucket_occupancy").set(occupancy)
+        reg.gauge("Serve/padding_waste").set(waste)
+        with self._lock:
+            self._completed += len(live)
+            self._batches += 1
+            self._bucket_counts[bucket] = \
+                self._bucket_counts.get(bucket, 0) + 1
+            self._occupancy_sum += occupancy
+            self._pad_rows += nexec - n
+            self._exec_rows += nexec
+
+    # -- telemetry / lifecycle ------------------------------------------
+
+    def stats(self, reset_window: bool = True) -> dict:
+        """Aggregate serve telemetry; also refreshes the ``Serve/qps``
+        and ``Serve/p99_ms`` gauges. ``reset_window`` makes qps AND the
+        latency percentiles cover the interval since the previous
+        resetting call — and bounds the histogram's memory, which would
+        otherwise grow one float per request forever on a long-lived
+        server; pass False for a pure peek (the /metrics endpoint)."""
+        with self._lock:
+            now = time.perf_counter()
+            interval = max(now - self._qps_t0, 1e-9)
+            qps = (self._completed - self._qps_n0) / interval
+            if reset_window:
+                self._qps_t0, self._qps_n0 = now, self._completed
+            lat = self._latency.snapshot(reset=reset_window)
+            out = {
+                "completed": self._completed,
+                "failed": self._failed,
+                "batches": self._batches,
+                "qps": qps,
+                "bucket_counts": dict(self._bucket_counts),
+                "mean_bucket_occupancy": (
+                    self._occupancy_sum / self._batches
+                    if self._batches else 0.0
+                ),
+                "padding_waste": (
+                    self._pad_rows / self._exec_rows
+                    if self._exec_rows else 0.0
+                ),
+                "latency_ms": lat,
+            }
+        reg = obs.get_registry()
+        reg.gauge("Serve/qps").set(qps)
+        if lat.get("count"):
+            reg.gauge("Serve/p99_ms").set(lat["p99"])
+        return out
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting requests; by default DRAIN what is queued
+        (every accepted future resolves), then stop the dispatcher and
+        unlink the staging ring."""
+        with self._cond:
+            if self._closing:
+                return
+            self._closing = True
+            if not drain and self._open is not None:
+                for r in self._open_reqs:
+                    if not r.future.done():
+                        r.future._fail(ServeError("batcher shut down"))
+                self._ring.abandon(self._open)
+                self._open = None
+                self._open_reqs = []
+            self._cond.notify_all()
+        self._dispatcher.join(timeout)
+        self._ring.close()
+
+    def __del__(self):
+        try:
+            if not self._closing:
+                self.close(drain=False, timeout=1.0)
+        except Exception:
+            pass

@@ -1,0 +1,351 @@
+"""The inference engine: AOT bucket compilation, placement, hot-swap.
+
+Design (ISSUE 7 tentpole):
+
+* **AOT bucket ladder** — the forward pass is lowered + compiled at
+  construction for every batch-size bucket in the ladder
+  (``DPTPU_SERVE_BUCKETS``), so no request ever hits a compile stall:
+  the first request is as fast as the thousandth. Weights are a call
+  ARGUMENT, not a captured constant, so a hot-swap never recompiles.
+
+* **Batch-invariant numerics** — the = 0 logit-parity contract between
+  buckets needs per-row results that do not depend on the executable's
+  batch size. Two measured sources of batch-dependence on this
+  toolchain's CPU backend, each with its own counter (locked by the
+  parity test):
+
+  - XLA's M=1 matmul lowers to a gemv whose reduction order differs
+    from the M>=2 gemm path (max|Δlogit| ~ 3e-6 on a 512x1000 head) —
+    countered by the **execution floor**: every bucket executes at
+    ``max(bucket, 2)`` rows, so the single-request path rides the SAME
+    gemm lowering as every padded bucket. Exactness costs one duplicate
+    row through the trunk at bucket 1 (noise on an accelerator, the
+    honest price of = 0 on CPU).
+  - Eigen's MULTI-THREADED gemm splits the K reduction shape-dependently
+    (resnet18's 1x1 downsample conv diverged 5e-7 between exec 4 and
+    exec 8 on a 2-core host) — countered by compiling serve executables
+    with ``xla_cpu_multi_thread_eigen=false`` (``compiler_options``,
+    scoped to THESE executables only — training jits in the same
+    process keep threaded gemm). Measured cost on the 2-core bench box:
+    none (82.5 vs 87.8 ms for a bucket-16 resnet18@32 — thread handoff
+    outweighed the parallel win at serving shapes). TPU backends have
+    no Eigen and take no flag; the MXU's tiling is batch-invariant.
+
+* **Padded-batch execution** — a bucket runs with ``n_valid`` real rows
+  and ``exec - n_valid`` pad rows (row-0 repeats, the loader's padding
+  convention); eval-mode forwards are row-independent (BN uses running
+  stats), so pad content cannot perturb real rows, and the result is
+  sliced to ``n_valid``.
+
+* **Placement per family** (``resolve_placement``) — ``replicated``
+  runs the single-program forward; ``tp`` opens a ``model``-axis mesh
+  and shards params by the family's Megatron rule
+  (dptpu/parallel/gspmd.py ``tp_specs_for_arch``; activations
+  replicated, the partitioner inserts the per-block all-reduces).
+  ``auto`` picks TP for the three families with a real rule when more
+  than one device is visible, replicated otherwise.
+
+* **Generation-tagged weights** — ``swap_weights`` installs a new
+  weight generation without dropping in-flight requests: a dispatched
+  batch pins the generation it was assigned (``acquire_generation``),
+  every batch is served by exactly ONE generation (mixed-generation
+  serving is structurally impossible — one pytree per call), and a
+  superseded generation's buffers are dropped the moment its last
+  in-flight batch releases (``old generation drains``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dptpu import obs
+from dptpu.serve.knobs import parse_buckets
+
+# the measured gemv/gemm divergence floor (module docstring): every
+# executable's leading dim is >= 2 so all buckets share one lowering
+EXEC_FLOOR = 2
+
+
+def serve_compiler_options():
+    """Per-executable options for batch-invariant numerics (module
+    docstring): on the CPU backend, single-thread Eigen's gemm so
+    reduction order cannot depend on the batch dimension; elsewhere no
+    flag (and an unknown option would be rejected by the plugin)."""
+    if jax.default_backend() == "cpu":
+        return {"xla_cpu_multi_thread_eigen": False}
+    return None
+
+
+def resolve_placement(arch: str, placement: str,
+                      device_count: Optional[int] = None) -> str:
+    """``auto``/``replicated``/``tp`` -> the concrete placement, failing
+    fast on impossible requests (explicit ``tp`` for a family with no TP
+    rule, or on a single device) instead of silently degrading."""
+    from dptpu.parallel.gspmd import tp_rule_for_arch
+
+    if device_count is None:
+        device_count = jax.device_count()
+    rule = tp_rule_for_arch(arch)
+    if placement == "tp":
+        if rule == "dp_specs":
+            raise ValueError(
+                f"--placement=tp: no tensor-parallel sharding rule for "
+                f"{arch!r} (TP families: vit_*, swin*, convnext_* — see "
+                f"dptpu/parallel/gspmd.py tp_rule_for_arch); use "
+                f"--placement=replicated"
+            )
+        if device_count < 2:
+            raise ValueError(
+                f"--placement=tp needs >= 2 devices to open a model "
+                f"axis, found {device_count}"
+            )
+        return "tp"
+    if placement == "replicated":
+        return "replicated"
+    # auto: TP where a family rule exists and there is a mesh to use it
+    return "tp" if (rule != "dp_specs" and device_count >= 2) \
+        else "replicated"
+
+
+class ServeEngine:
+    """AOT bucket-compiled, hot-swappable eval forward for one registry
+    arch. ``variables`` takes explicit weights (tests/benches);
+    ``pretrained=True`` loads the converted-torchvision ``<arch>.npz``
+    (``DPTPU_PRETRAINED_DIR``); neither = random init (load-testing)."""
+
+    def __init__(self, arch: str, *, buckets: Sequence[int] = (1, 4, 16, 64),
+                 placement: str = "auto", num_classes: int = 1000,
+                 image_size: int = 224, variables: Optional[dict] = None,
+                 pretrained: bool = False,
+                 compute_dtype=jnp.float32, verbose: bool = False):
+        from dptpu.models import create_model
+
+        self.arch = arch
+        self.buckets = parse_buckets(buckets, source="buckets")
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.compute_dtype = compute_dtype
+        self.model = create_model(
+            arch, pretrained=pretrained, num_classes=num_classes
+        )
+        self.placement = resolve_placement(arch, placement)
+        input_shape = (1, image_size, image_size, 3)
+        if variables is None:
+            if pretrained:
+                from dptpu.models.pretrained import load_pretrained_variables
+
+                variables = load_pretrained_variables(
+                    arch, self.model, input_shape=input_shape
+                )
+            else:
+                init = self.model.init(
+                    jax.random.PRNGKey(0),
+                    np.zeros(input_shape, np.float32), train=False,
+                )
+                variables = {"params": init["params"],
+                             "batch_stats": init.get("batch_stats", {})}
+        variables = {"params": variables["params"],
+                     "batch_stats": variables.get("batch_stats", {})}
+
+        self._mesh = None
+        self._var_shardings = None
+        self.tp_rule = "dp_specs"
+        if self.placement == "tp":
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dptpu.parallel.gspmd import tp_specs_for_arch
+            from dptpu.parallel.mesh import MODEL_AXIS, make_mesh
+
+            self._mesh = make_mesh(
+                mesh_shape={MODEL_AXIS: jax.device_count()}
+            )
+            self.tp_rule, specs = tp_specs_for_arch(
+                arch, variables["params"]
+            )
+            rep = NamedSharding(self._mesh, P())
+            self._var_shardings = {
+                "params": jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self._mesh, s), specs
+                ),
+                "batch_stats": jax.tree_util.tree_map(
+                    lambda _: rep, variables["batch_stats"]
+                ),
+            }
+            self._img_sharding = rep
+            self._out_sharding = rep
+
+        # generation store: {gen: device-placed variables}; a dispatched
+        # batch pins its generation until its logits materialize
+        self._lock = threading.Lock()
+        self._gen = 1
+        self._weights: Dict[int, dict] = {1: self._place(variables)}
+        self._inflight: Dict[int, int] = {1: 0}
+
+        # AOT compile the ladder (dedup buckets that share an exec size:
+        # 1 and 2 both execute at the floor)
+        self._compiled = {}
+        var_structs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self._weights[1],
+        )
+        for b in self.buckets:
+            nexec = self.exec_batch(b)
+            if nexec in self._compiled:
+                continue
+            with obs.get_tracer().span("serve_compile"):
+                self._compiled[nexec] = self._compile_at(nexec, var_structs)
+            if verbose:
+                print(f"=> serve: AOT-compiled {arch} bucket {b} "
+                      f"(exec batch {nexec}, {self.placement})")
+
+    # -- compilation ----------------------------------------------------
+
+    def _forward(self, variables, images):
+        from dptpu.train.step import normalize_images
+
+        x = normalize_images(images, self.compute_dtype)
+        out = self.model.apply(variables, x, train=False)
+        return out.astype(jnp.float32)
+
+    def _compile_at(self, nexec: int, var_structs):
+        img = jax.ShapeDtypeStruct(
+            (nexec, self.image_size, self.image_size, 3), jnp.uint8
+        )
+        if self.placement == "tp":
+            fn = jax.jit(
+                self._forward,
+                in_shardings=(self._var_shardings, self._img_sharding),
+                out_shardings=self._out_sharding,
+                compiler_options=serve_compiler_options(),
+            )
+        else:
+            fn = jax.jit(
+                self._forward, compiler_options=serve_compiler_options()
+            )
+        return fn.lower(var_structs, img).compile()
+
+    def exec_batch(self, bucket: int) -> int:
+        """The executable's leading dim for ``bucket`` (the >= 2 floor)."""
+        return max(int(bucket), EXEC_FLOOR)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= ``n`` (the batcher's coalescing target)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"{n} requests exceed the largest bucket "
+            f"{self.buckets[-1]} — the batcher must split first"
+        )
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    # -- weight generations ---------------------------------------------
+
+    def _place(self, variables):
+        if self.placement == "tp":
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(np.asarray(x), s),
+                variables, self._var_shardings,
+            )
+        return jax.device_put(variables)
+
+    def swap_weights(self, variables) -> int:
+        """Install a new weight generation (same tree/shapes — validated
+        against the compiled signature by construction: a mismatched
+        tree fails the compiled call loudly, not silently). In-flight
+        batches keep serving their pinned generation; the old one is
+        dropped when its last batch releases. Returns the new id."""
+        variables = {"params": variables["params"],
+                     "batch_stats": variables.get("batch_stats", {})}
+        placed = self._place(variables)  # off-lock: device transfer
+        with self._lock:
+            self._gen += 1
+            self._weights[self._gen] = placed
+            self._inflight[self._gen] = 0
+            self._drop_drained_locked()
+            return self._gen
+
+    def acquire_generation(self) -> int:
+        """Pin the CURRENT generation for one batch; the batch is served
+        with this generation's weights no matter what swaps land while
+        it is in flight."""
+        with self._lock:
+            gen = self._gen
+            self._inflight[gen] += 1
+            return gen
+
+    def release_generation(self, gen: int) -> None:
+        with self._lock:
+            self._inflight[gen] -= 1
+            self._drop_drained_locked()
+
+    def _drop_drained_locked(self):
+        for g in [g for g in self._weights
+                  if g != self._gen and self._inflight[g] == 0]:
+            del self._weights[g]
+            del self._inflight[g]
+
+    def generations(self) -> Tuple[int, ...]:
+        """Live (resident) generation ids — newest is current; older
+        ones are draining."""
+        with self._lock:
+            return tuple(sorted(self._weights))
+
+    @property
+    def current_generation(self) -> int:
+        return self._gen
+
+    # -- execution ------------------------------------------------------
+
+    def run_bucket(self, bucket: int, images_exec: np.ndarray,
+                   n_valid: int, gen: Optional[int] = None) -> np.ndarray:
+        """Run one padded bucket: ``images_exec`` is the FULL
+        ``exec_batch(bucket)``-row array (pad rows already filled — the
+        batcher repeats row 0), ``n_valid`` of which are real. Blocks
+        until the logits are on the host (which is also the moment the
+        input buffer is provably no longer read — the staging lease may
+        release after this returns, CPU-PJRT aliasing included). Returns
+        float32 ``[n_valid, num_classes]``."""
+        nexec = self.exec_batch(bucket)
+        if images_exec.shape[0] != nexec:
+            raise ValueError(
+                f"bucket {bucket} executes at {nexec} rows, got "
+                f"{images_exec.shape[0]}"
+            )
+        owns_gen = gen is None
+        if owns_gen:
+            gen = self.acquire_generation()
+        try:
+            with self._lock:
+                weights = self._weights[gen]
+            with obs.get_tracer().span("serve_device"):
+                out = self._compiled[nexec](weights, images_exec)
+                logits = np.asarray(out)  # blocks: device done with input
+        finally:
+            if owns_gen:
+                self.release_generation(gen)
+        return logits[:n_valid]
+
+    def infer(self, images: np.ndarray) -> np.ndarray:
+        """Convenience single-shot path (tests, the CLI self-test): pick
+        the bucket for ``len(images)``, pad with row-0 repeats, run,
+        slice. The batcher's zero-copy path calls ``run_bucket`` on a
+        staging-slot view instead."""
+        images = np.ascontiguousarray(images, dtype=np.uint8)
+        n = images.shape[0]
+        nexec = self.exec_batch(self.bucket_for(n))
+        if n < nexec:
+            pad = np.broadcast_to(
+                images[0], (nexec - n,) + images.shape[1:]
+            )
+            images = np.concatenate([images, pad], axis=0)
+        return self.run_bucket(self.bucket_for(n), images, n)

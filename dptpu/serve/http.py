@@ -1,0 +1,101 @@
+"""Minimal stdlib HTTP front end over the batcher (the ``dptpu serve``
+subcommand's listener — no web framework in this environment, and none
+needed: the threading server's one-thread-per-connection model is
+exactly the batcher's submission model, where the caller's thread does
+the request's preprocessing).
+
+Endpoints:
+
+* ``POST /predict`` — body = image bytes (any PIL-decodable container);
+  response = JSON ``{"top5": [[class_index, logit], ...],
+  "generation": g, "timings": {...}}``. Undecodable bytes → 400.
+* ``GET /healthz`` — liveness + the engine's arch/bucket ladder.
+* ``GET /metrics`` — the obs registry's flat scalar snapshot plus the
+  batcher's aggregate stats (``Serve/*`` group included).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def make_handler(batcher):
+    engine = batcher.engine
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "dptpu-serve/1"
+
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet: obs carries telemetry
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {
+                    "ok": True, "arch": engine.arch,
+                    "buckets": list(engine.buckets),
+                    "placement": engine.placement,
+                    "generation": engine.current_generation,
+                })
+            elif self.path == "/metrics":
+                from dptpu import obs
+
+                self._send(200, {
+                    "registry": obs.get_registry().scalars(),
+                    "serve": batcher.stats(reset_window=False),
+                })
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = -1  # malformed header = a bad request, not a
+                #              handler traceback + dropped connection
+            if not 0 < length <= 64 << 20:
+                self._send(400, {"error": "missing or oversized body"})
+                return
+            data = self.rfile.read(length)
+            try:
+                fut = batcher.submit_bytes(data)
+                logits = fut.result(timeout=60.0)
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+                return
+            except Exception as e:
+                self._send(500, {"error": str(e)})
+                return
+            top = logits.argsort()[::-1][:5]
+            self._send(200, {
+                "top5": [[int(i), float(logits[i])] for i in top],
+                "generation": fut.generation,
+                "timings": {k: round(v, 3) if isinstance(v, float) else v
+                            for k, v in fut.timings.items()},
+            })
+
+    return Handler
+
+
+def serve_forever(batcher, host: str = "127.0.0.1", port: int = 8000):
+    """Blocking listener; Ctrl-C (or ``shutdown()`` from another thread)
+    returns, leaving batcher lifecycle to the caller."""
+    httpd = ThreadingHTTPServer((host, port), make_handler(batcher))
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return httpd

@@ -1,0 +1,137 @@
+"""Serve-side env knobs — the locked fail-fast contract, serving edition.
+
+Same discipline as the feed/opt/obs knobs (dptpu/envknob.py): an unset
+or empty knob means "use the default / the CLI value", every EXPLICIT
+value must parse and validate or raise an actionable error, and the env
+twin WINS over the CLI/config value when both are set (the precedence
+every ``DPTPU_*`` knob in this repo follows — benches and tests drive
+fit()/serve() through env without forking argv plumbing).
+
+Knobs:
+
+* ``DPTPU_SERVE_BUCKETS`` — comma list of AOT-compiled batch-size
+  buckets (default ``1,4,16,64``); each positive, strictly increasing;
+* ``DPTPU_SERVE_MAX_DELAY_MS`` — the batcher's coalescing latency
+  budget (default 5.0; ``0`` = dispatch immediately, never wait);
+* ``DPTPU_SERVE_PLACEMENT`` — ``auto`` / ``replicated`` / ``tp``
+  (auto: TP for the families with a real TP rule when >1 device,
+  replicated otherwise — dptpu/parallel/gspmd.py ``tp_rule_for_arch``);
+* ``DPTPU_SERVE_SLOTS`` — staging-ring depth in leased batch slots
+  (default 4, >= 2: one filling + one in flight).
+
+Stdlib-only: the CLI validates pre-jax (a typo'd knob must fail before
+any compile), and the conftest leak guard imports the serve package.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+from dptpu.envknob import env_choice, env_float, env_int
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
+DEFAULT_MAX_DELAY_MS = 5.0
+DEFAULT_SLOTS = 4
+
+PLACEMENTS = ("auto", "replicated", "tp")
+
+
+class ServeKnobs(NamedTuple):
+    buckets: Tuple[int, ...]
+    max_delay_ms: float
+    placement: str
+    slots: int
+
+
+def parse_buckets(raw, source: str = "DPTPU_SERVE_BUCKETS"
+                  ) -> Tuple[int, ...]:
+    """Validate a bucket ladder (comma string or int sequence): every
+    bucket a positive int, strictly increasing — an unsorted or
+    duplicated ladder would make "smallest bucket >= n" ambiguous, so it
+    raises instead of silently sorting."""
+    if isinstance(raw, str):
+        parts = [p.strip() for p in raw.split(",") if p.strip()]
+        if not parts:
+            raise ValueError(
+                f"{source}={raw!r} names no buckets (expected e.g. "
+                f"{source}=1,4,16,64)"
+            )
+        try:
+            buckets = tuple(int(p) for p in parts)
+        except ValueError:
+            raise ValueError(
+                f"{source}={raw!r} is not a comma list of integers "
+                f"(expected e.g. {source}=1,4,16,64)"
+            ) from None
+    else:
+        buckets = tuple(int(b) for b in raw)
+        if not buckets:
+            raise ValueError(f"{source}: empty bucket ladder")
+    if any(b < 1 for b in buckets):
+        raise ValueError(
+            f"{source}={','.join(map(str, buckets))}: every bucket must "
+            f"be a positive batch size"
+        )
+    if any(a >= b for a, b in zip(buckets, buckets[1:])):
+        raise ValueError(
+            f"{source}={','.join(map(str, buckets))}: buckets must be "
+            f"strictly increasing (the batcher picks the smallest bucket "
+            f">= the coalesced request count)"
+        )
+    return buckets
+
+
+def serve_knobs(buckets: Optional[Sequence[int]] = None,
+                max_delay_ms: Optional[float] = None,
+                placement: Optional[str] = None,
+                slots: Optional[int] = None,
+                environ=None) -> ServeKnobs:
+    """Resolve + validate the serve knobs. Arguments are the CLI/config
+    values (None = not given); the env twins override them when set; the
+    IDENTICAL validation applies either way (a programmatic caller's bad
+    ladder fails exactly like a typo'd env)."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    raw_buckets = env.get("DPTPU_SERVE_BUCKETS", "").strip()
+    if raw_buckets:
+        out_buckets = parse_buckets(raw_buckets)
+    elif buckets is not None:
+        out_buckets = parse_buckets(buckets, source="--buckets")
+    else:
+        out_buckets = DEFAULT_BUCKETS
+
+    delay = env_float("DPTPU_SERVE_MAX_DELAY_MS", None, environ=env)
+    source = "DPTPU_SERVE_MAX_DELAY_MS"
+    if delay is None:
+        delay, source = max_delay_ms, "--max-delay-ms"
+    if delay is None:
+        delay = DEFAULT_MAX_DELAY_MS
+    if delay < 0:
+        raise ValueError(
+            f"{source}={delay} must be >= 0 ms (0 dispatches every "
+            f"request immediately, never coalescing)"
+        )
+
+    place = env_choice("DPTPU_SERVE_PLACEMENT", PLACEMENTS, None,
+                       environ=env)
+    if place is None:
+        place = placement if placement is not None else "auto"
+    if place not in PLACEMENTS:
+        raise ValueError(
+            f"--placement={place!r} must be one of "
+            + "/".join(repr(p) for p in PLACEMENTS)
+        )
+
+    n_slots = env_int("DPTPU_SERVE_SLOTS", None, environ=env)
+    source = "DPTPU_SERVE_SLOTS"
+    if n_slots is None:
+        n_slots, source = slots, "--slots"
+    if n_slots is None:
+        n_slots = DEFAULT_SLOTS
+    if n_slots < 2:
+        raise ValueError(
+            f"{source}={n_slots} must be >= 2 staging slots (one "
+            f"filling while one is leased to the device)"
+        )
+    return ServeKnobs(out_buckets, float(delay), place, int(n_slots))

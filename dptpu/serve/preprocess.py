@@ -1,0 +1,99 @@
+"""Request preprocessing: image bytes -> model-ready uint8 HWC tensor.
+
+ONE implementation of "what pixels does a request become", shared by the
+serving engine, ``scripts/check_tv_parity.py`` and any offline caller:
+the pixel-exact validation stack (``ValTransform`` — Resize(256) →
+CenterCrop(224) as one fractional-box resample, dptpu/data/transforms.py)
+applied to a PIL RGB decode of the bytes.
+
+Bit-identity contract (locked by tests/test_serve.py): for a given image
+file, ``preprocess_bytes(open(f,'rb').read())`` equals the row the
+training/eval pipeline produces for that file —
+``ImageFolderDataset(transform=ValTransform()).get(i)`` — byte for byte.
+That holds because this IS the same code path: ``ValTransform`` sets
+``native_ok = False``, so the val pipeline always decodes via PIL
+(reproducing torchvision's published-accuracy pixels; the native fast
+path's scaled decode + 2-tap lerp is augmentation-grade — see the
+ValTransform docstring), and so does this function. A model served here
+sees exactly the pixels its reported validation accuracy was measured
+on.
+
+Output stays uint8 HWC: like the training feed, normalization happens
+on device inside the compiled forward (``normalize_images``) — x4 less
+staging-buffer traffic and one fewer host-side float pass per request.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+
+from dptpu.data.transforms import ValTransform
+
+
+def val_resize_for(size: int) -> int:
+    """The val pipeline's resize edge for a crop of ``size``: the
+    reference 256-resize-then-224-crop ratio, scaled (fit.py builds the
+    val dataset with exactly this formula — 256 at the standard 224).
+    Serving MUST use the same formula or a non-224 engine would crop a
+    different fraction of the image than the accuracy was measured on."""
+    return int(size * 256 / 224)
+
+
+def preprocess_bytes(data: bytes, size: int = 224,
+                     resize: Optional[int] = None,
+                     out: Optional[np.ndarray] = None,
+                     _transform: Optional[ValTransform] = None
+                     ) -> np.ndarray:
+    """Decode + val-transform one request's image bytes.
+
+    ``resize`` defaults to ``val_resize_for(size)`` — the val
+    pipeline's own edge, at EVERY size, not just 224.
+
+    ``out`` (uint8 ``(size, size, 3)``) lets the batcher write the pixels
+    straight into a staging-ring row — the request-side analog of the
+    loader's decode-into-slot path; anything else allocates. JPEG, PNG
+    and every other PIL-decodable container are accepted (requests are
+    not guaranteed to be JPEG); undecodable bytes raise ``ValueError``
+    naming the cause, so a bad request 400s instead of crashing a batch.
+
+    ``_transform`` lets a hot caller reuse one ``ValTransform`` (it is
+    stateless; the default constructs per call for the one-shot case).
+    """
+    from PIL import Image, UnidentifiedImageError
+
+    if resize is None:
+        resize = val_resize_for(size)
+    tf = _transform if _transform is not None else ValTransform(size, resize)
+    try:
+        with Image.open(io.BytesIO(data)) as img:
+            arr = tf(img.convert("RGB"))
+    except (UnidentifiedImageError, OSError) as e:
+        raise ValueError(f"undecodable image bytes: {e}") from None
+    if out is not None:
+        if out.shape != arr.shape or out.dtype != np.uint8:
+            raise ValueError(
+                f"preprocess out buffer is {out.dtype}{out.shape}, "
+                f"expected uint8{arr.shape}"
+            )
+        np.copyto(out, arr)
+        return out
+    return arr
+
+
+def preprocess_array(img: np.ndarray, size: int = 224,
+                     resize: Optional[int] = None,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Same val stack over an already-decoded uint8 HWC array (the
+    bench's synthetic-request path — no container round trip)."""
+    from PIL import Image
+
+    tf = ValTransform(size, resize if resize is not None
+                      else val_resize_for(size))
+    arr = tf(Image.fromarray(img))
+    if out is not None:
+        np.copyto(out, arr)
+        return out
+    return arr

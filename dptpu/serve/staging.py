@@ -1,0 +1,176 @@
+"""Leased shared-memory staging ring for request batches.
+
+The serving twin of the feed's zero-copy batch-slot ring
+(dptpu/data/shm.py): preprocessed request pixels are written ONCE, into
+a row of a preallocated /dev/shm slot, and the device reads from that
+same memory — no per-request copy-out, no per-batch assemble. The
+handoff protocol is literally the feed's: a dispatched slot is held by a
+:class:`dptpu.data.shm.SlotLease` (the same class — generation-checked,
+double-release-safe) and recycles only on ``release()``, which the
+engine performs after the batch's logits have materialized (by then the
+device has consumed the input bytes on every backend, including the
+CPU PJRT whose ``device_put`` zero-copy-aliases host buffers — the
+DevicePrefetcher's aliasing hazard, defended here by ordering rather
+than copying).
+
+Segments are named ``dptpu_serve_{pid}_{hex}`` so the conftest /dev/shm
+leak guard polices them exactly like ``dptpu_ring_*``/``dptpu_cache_*``;
+``live_segment_names()`` is its allowlist and ``leaked_lease_count()``
+its close-with-lease-outstanding counter, mirroring dptpu/data/shm.
+
+Slot lifecycle: FREE -> FILLING (the batcher's one open slot, rows
+claimed per request) -> LEASED (dispatched to the device) -> FREE
+(lease released). /dev/shm rather than plain numpy so a future
+process-pool preprocessor (the feed's worker model) can decode straight
+into the ring without a byte of plumbing changing.
+
+jax-free by design: the conftest guard and the CLI's fail-fast path
+import this module before any backend exists.
+"""
+
+from __future__ import annotations
+
+import atexit
+import weakref
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dptpu.data.shm import SlotLease
+from dptpu.data.shm_cache import close_segment, create_named_segment
+
+SEGMENT_PREFIX = "dptpu_serve"
+
+_LIVE_RINGS: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_REGISTERED = False
+
+# slots still leased when their ring closed — a serve-side protocol bug
+# (the engine must release after logits materialize); the conftest
+# session fixture fails the suite when this moves
+_LEASE_LEAKS = 0
+
+_FREE, _FILLING, _LEASED = 0, 1, 2
+
+
+def leaked_lease_count() -> int:
+    """Staging slots still leased when their ring closed, summed over
+    every ring this process has closed (same contract as
+    ``dptpu.data.shm.leaked_lease_count``)."""
+    return _LEASE_LEAKS
+
+
+def live_segment_names():
+    """Segment names owned by still-open rings in THIS process (the
+    conftest /dev/shm leak guard's allowlist)."""
+    return {
+        ring._shm.name.lstrip("/")
+        for ring in list(_LIVE_RINGS)
+        if not ring._closed
+    }
+
+
+def _atexit_close_all():
+    for ring in list(_LIVE_RINGS):
+        try:
+            ring.close()
+        except Exception:
+            pass
+
+
+def _register(ring):
+    global _ATEXIT_REGISTERED
+    _LIVE_RINGS.add(ring)
+    if not _ATEXIT_REGISTERED:
+        atexit.register(_atexit_close_all)
+        _ATEXIT_REGISTERED = True
+
+
+class StagingRing:
+    """``slots`` request-batch buffers of ``bucket_max`` rows each, in one
+    named /dev/shm segment."""
+
+    def __init__(self, slots: int, bucket_max: int,
+                 item_shape: Tuple[int, int, int]):
+        if slots < 2:
+            raise ValueError(
+                f"staging ring needs >= 2 slots (one filling + one "
+                f"leased), got {slots}"
+            )
+        self.slots = slots
+        self.bucket_max = bucket_max
+        self.item_shape = tuple(item_shape)
+        nbytes = int(np.prod((slots, bucket_max) + self.item_shape))
+        self._shm = create_named_segment(SEGMENT_PREFIX, nbytes)
+        self._imgs = np.ndarray(
+            (slots, bucket_max) + self.item_shape, np.uint8,
+            buffer=self._shm.buf,
+        )
+        self._state = [_FREE] * slots
+        self._gen = [0] * slots
+        self._closed = False
+        _register(self)
+
+    def acquire(self) -> Optional[int]:
+        """Claim a FREE slot for filling; None when every slot is either
+        the open one or still leased to an in-flight batch (the
+        batcher's backpressure moment)."""
+        for s in range(self.slots):
+            if self._state[s] == _FREE:
+                self._state[s] = _FILLING
+                return s
+        return None
+
+    def rows(self, slot: int) -> np.ndarray:
+        """The slot's ``[bucket_max, H, W, C]`` view — the batcher hands
+        out one row per request for in-place preprocessing."""
+        return self._imgs[slot]
+
+    def lease(self, slot: int) -> SlotLease:
+        """Dispatch the FILLING slot: it stays byte-stable until the
+        returned lease is released (the engine does, after the batch's
+        logits are on the host)."""
+        if self._state[slot] != _FILLING:
+            raise RuntimeError(
+                f"staging slot {slot} leased while "
+                f"{'FREE' if self._state[slot] == _FREE else 'already leased'}"
+            )
+        self._state[slot] = _LEASED
+        return SlotLease(self, slot, self._gen[slot])
+
+    def abandon(self, slot: int) -> None:
+        """Return a FILLING slot unleased (batcher shutdown with
+        requests still queued — their futures fail, the slot frees)."""
+        if self._state[slot] == _FILLING:
+            self._state[slot] = _FREE
+            self._gen[slot] += 1
+
+    def _release_slot(self, slot: int, gen: int) -> None:
+        # SlotLease's callback — generation check makes a late release
+        # against a closed/recycled ring a no-op (shared contract with
+        # the feed ring)
+        if self._closed or self._gen[slot] != gen \
+                or self._state[slot] != _LEASED:
+            return
+        self._state[slot] = _FREE
+        self._gen[slot] += 1
+
+    def leased_count(self) -> int:
+        return sum(1 for s in self._state if s == _LEASED)
+
+    def free_count(self) -> int:
+        return sum(1 for s in self._state if s == _FREE)
+
+    def close(self) -> None:
+        global _LEASE_LEAKS
+        if self._closed:
+            return
+        self._closed = True
+        _LEASE_LEAKS += self.leased_count()
+        self._imgs = None
+        close_segment(self._shm, unlink=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

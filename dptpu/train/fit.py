@@ -337,11 +337,16 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     if use_sp and accum_steps > 1:
         # fail fast rather than silently changing the effective batch:
         # the sequence-parallel step has no microbatch scan (its token
-        # axis already divides the work another way)
+        # axis already divides the work another way). Name the offending
+        # knob AND the supported alternatives (message locked by
+        # tests/test_opt_knobs.py::test_sp_accum_error_names_knob_and_alternative)
         raise ValueError(
-            f"--accum-steps/DPTPU_ACCUM {accum_steps} is not supported "
-            f"with DPTPU_SP (no microbatch scan in the sequence-parallel "
-            f"step) — drop one of the two"
+            f"--accum-steps/DPTPU_ACCUM={accum_steps} has no "
+            f"sequence-parallel implementation (DPTPU_SP={sp_n} replaces "
+            f"the microbatch scan with a token-axis split); supported "
+            f"alternatives: set DPTPU_ACCUM=1 and keep DPTPU_SP={sp_n}, "
+            f"or unset DPTPU_SP to get data-parallel gradient "
+            f"accumulation"
         )
     if single_device:
         mesh = None

@@ -173,20 +173,36 @@ def roundtrip_selftest(archs, n_inputs, image):
 
 
 def val_transform_ab():
-    """Section 3: fused one-box resample vs exact two-step pipeline."""
+    """Section 3: fused one-box resample vs exact two-step pipeline.
+
+    The fused arm runs through ``dptpu.serve.preprocess_bytes`` — the
+    SAME function the serving engine feeds requests through — so this
+    harness also locks, with a number, that the serving ingest path is
+    the published-accuracy pixel path (``serve_ingest_bit_identical``;
+    PNG round trip is lossless, so any delta would be a real transform
+    divergence)."""
+    import io
+
     from PIL import Image
 
     from dptpu.data.transforms import ValTransform
+    from dptpu.serve import preprocess_bytes
 
     fused = ValTransform(224, 256)
     rng = np.random.RandomState(0)
     cases = []
+    serve_identical = True
     for (w, h) in [(500, 400), (400, 500), (640, 480), (256, 256),
                    (1024, 768), (300, 224), (231, 256)]:
         # textured content (flat images would hide resample differences)
         low = rng.randint(0, 255, (h // 8, w // 8, 3), np.uint8)
         img = Image.fromarray(low).resize((w, h), Image.BILINEAR)
-        a = fused(img).astype(np.int16)
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        a = preprocess_bytes(
+            buf.getvalue(), size=224, resize=256
+        ).astype(np.int16)
+        serve_identical &= bool(np.array_equal(a, fused(img)))
         # torchvision-exact two-step: Resize(256) scales the SHORT edge
         # to 256, long edge int(256*long/short) — TRUNCATION, the
         # torchvision _compute_resized_output_size formula — then
@@ -213,10 +229,12 @@ def val_transform_ab():
               f"{100 * (d > 2).mean():.2f}%)")
     return {
         "what": "fused center_fit_box one-box resample vs exact "
-                "Resize(256)->CenterCrop(224) two-step, uint8 deltas",
+                "Resize(256)->CenterCrop(224) two-step, uint8 deltas; "
+                "fused arm fed through dptpu.serve.preprocess_bytes",
         "cases": cases,
         "worst_max_abs_px": max(c["max_abs_px"] for c in cases),
         "worst_mean_abs_px": max(c["mean_abs_px"] for c in cases),
+        "serve_ingest_bit_identical": serve_identical,
     }
 
 

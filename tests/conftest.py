@@ -48,6 +48,13 @@ _FAST_MODULES = {
     # test_fault_resume precedent) — the accumulation/trust-ratio locks
     # must hold in tier 1
     "test_opt_knobs", "test_optimizers",
+    # serving (PR 7): knob validation is pure; test_serve compiles only
+    # tiny-model bucket ladders (resnet18@32 / vit_b_32@64 — the
+    # test_fault_resume precedent) and holds the ISSUE acceptance bar —
+    # padded-bucket logit identity and hot-swap draining MUST hold in
+    # tier 1; the servebench smoke is the third fit-shaped exception
+    # (one subprocess, --smoke preset, same gates as SERVEBENCH.json)
+    "test_serve", "test_serve_knobs", "test_servebench_smoke",
 }
 
 
@@ -68,25 +75,32 @@ def dptpu_shm_leak_guard():
     A segment that is neither was abandoned without ``close()`` and
     would leak host RAM until reboot in production.
 
-    Also policed: ring LEASES. A slot still leased when its pipeline
-    closed was neither released by the consumer nor revoked by an
-    epoch reset / loader-initiated rebuild — a zero-copy protocol bug
-    that would pin (and, worse, silently recycle under) live batch
-    views in production. ``shm.leaked_lease_count()`` only advances on
+    Also policed: LEASES — the feed ring's AND the serve staging ring's
+    (``dptpu_serve_*``, dptpu/serve/staging.py — same SlotLease
+    protocol). A slot still leased when its pipeline/ring closed was
+    neither released by the consumer nor revoked by an epoch reset /
+    loader-initiated rebuild — a zero-copy protocol bug that would pin
+    (and, worse, silently recycle under) live batch views in
+    production. The ``leaked_lease_count()``s only advance on
     close-with-lease-outstanding, so abandoned epochs whose leases the
     generator backstop or a reset reclaimed stay clean."""
     import glob
 
     from dptpu.data import shm as _shm
+    from dptpu.serve import staging as _serve_staging
 
-    leases_before = _shm.leaked_lease_count()
+    def lease_leaks():
+        return (_shm.leaked_lease_count()
+                + _serve_staging.leaked_lease_count())
+
+    leases_before = lease_leaks()
     if not os.path.isdir("/dev/shm"):
         yield  # platform without a tmpfs view; segments can't be policed
         import gc
 
         gc.collect()
-        assert _shm.leaked_lease_count() == leases_before, (
-            "ring slots were still leased when their pipeline closed "
+        assert lease_leaks() == leases_before, (
+            "slots were still leased when their pipeline/ring closed "
             "(consumer never released, no reset revoked) — a zero-copy "
             "lease leak"
         )
@@ -96,7 +110,8 @@ def dptpu_shm_leak_guard():
     # attach), so scoping to our pid keeps concurrent dptpu runs on the
     # same host from tripping the guard
     mine = (f"/dev/shm/dptpu_ring_{os.getpid()}_*",
-            f"/dev/shm/dptpu_cache_{os.getpid()}_*")
+            f"/dev/shm/dptpu_cache_{os.getpid()}_*",
+            f"/dev/shm/dptpu_serve_{os.getpid()}_*")
     snapshot = lambda: {p for pat in mine for p in glob.glob(pat)}  # noqa: E731
     before = snapshot()
     yield
@@ -108,16 +123,17 @@ def dptpu_shm_leak_guard():
     live = {
         "/dev/shm/" + n.lstrip("/")
         for n in (_shm.live_segment_names()
-                  | _shm_cache.live_segment_names())
+                  | _shm_cache.live_segment_names()
+                  | _serve_staging.live_segment_names())
     }
     leaked = snapshot() - before - live
     assert not leaked, (
         f"leaked /dev/shm segments (created during the suite, not "
-        f"closed, not owned by any live pipeline/cache): "
+        f"closed, not owned by any live pipeline/cache/staging ring): "
         f"{sorted(leaked)}"
     )
-    assert _shm.leaked_lease_count() == leases_before, (
-        "ring slots were still leased when their pipeline closed "
+    assert lease_leaks() == leases_before, (
+        "slots were still leased when their pipeline/ring closed "
         "(consumer never released, no reset revoked) — a zero-copy "
         "lease leak"
     )

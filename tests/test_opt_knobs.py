@@ -131,6 +131,28 @@ def test_fit_rejects_accum_not_dividing_per_device_batch(monkeypatch):
         fit(cfg, image_size=32, verbose=False)
 
 
+def test_sp_accum_error_names_knob_and_alternative(monkeypatch):
+    """The sequence-parallel step's accumulation fail-fast (ROADMAP
+    PR-6 follow-on) must name the offending knob AND the supported
+    alternatives, not just refuse — locked here so a reworded message
+    cannot silently lose the actionable half."""
+    from dptpu.train.fit import fit
+
+    monkeypatch.setenv("DPTPU_SP", "2")
+    # batch 16 on the 8-device fake pod -> per-device 2, accum 2
+    # divides it, so the SP x accum conflict is the FIRST error hit
+    cfg = Config(data="synthetic:16", arch="vit_b_32", batch_size=16,
+                 epochs=1, accum_steps=2)
+    with pytest.raises(ValueError) as ei:
+        fit(cfg, image_size=32, verbose=False)
+    msg = str(ei.value)
+    assert "DPTPU_ACCUM=2" in msg  # the offending knob, with its value
+    assert "DPTPU_SP=2" in msg  # the conflicting axis knob
+    # both supported alternatives are spelled out
+    assert "DPTPU_ACCUM=1" in msg
+    assert "unset DPTPU_SP" in msg
+
+
 def test_cli_flags_parse_into_config():
     from dptpu.config import parse_config
 

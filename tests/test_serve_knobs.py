@@ -1,0 +1,123 @@
+"""The locked fail-fast env-knob contract, serving edition (mirrors
+tests/test_feed_knobs.py / test_opt_knobs.py): every explicitly-set-but-
+invalid DPTPU_SERVE_* value raises pre-compile with an actionable
+message, the env twin overrides the CLI value, programmatic values get
+IDENTICAL validation, and unknown model/placement names raise."""
+
+import pytest
+
+from dptpu.cli import build_serve_parser, serve_args_to_knobs
+from dptpu.serve import (
+    DEFAULT_BUCKETS,
+    DEFAULT_MAX_DELAY_MS,
+    DEFAULT_SLOTS,
+    parse_buckets,
+    serve_knobs,
+)
+
+_KNOBS = ("DPTPU_SERVE_BUCKETS", "DPTPU_SERVE_MAX_DELAY_MS",
+          "DPTPU_SERVE_PLACEMENT", "DPTPU_SERVE_SLOTS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_defaults():
+    k = serve_knobs()
+    assert k == (DEFAULT_BUCKETS, DEFAULT_MAX_DELAY_MS, "auto",
+                 DEFAULT_SLOTS)
+
+
+def test_env_overrides_cli_values(monkeypatch):
+    monkeypatch.setenv("DPTPU_SERVE_BUCKETS", "2,8")
+    monkeypatch.setenv("DPTPU_SERVE_MAX_DELAY_MS", "12.5")
+    monkeypatch.setenv("DPTPU_SERVE_PLACEMENT", "replicated")
+    monkeypatch.setenv("DPTPU_SERVE_SLOTS", "6")
+    k = serve_knobs(buckets="1,4", max_delay_ms=1.0, placement="tp",
+                    slots=2)
+    assert k == ((2, 8), 12.5, "replicated", 6)
+
+
+def test_cli_values_pass_through():
+    k = serve_knobs(buckets="1,2,4", max_delay_ms=0.0,
+                    placement="replicated", slots=3)
+    assert k == ((1, 2, 4), 0.0, "replicated", 3)
+
+
+def test_buckets_must_be_sorted_positive():
+    for bad in ("4,1", "1,1,4", "0,4", "-1,4", "1,x", ","):
+        with pytest.raises(ValueError, match="DPTPU_SERVE_BUCKETS|bucket"):
+            serve_knobs(environ={"DPTPU_SERVE_BUCKETS": bad})
+    # empty/unset = the default ladder (the contract's absence rule)
+    assert serve_knobs(environ={"DPTPU_SERVE_BUCKETS": ""}).buckets \
+        == DEFAULT_BUCKETS
+    # programmatic ladders get the identical validation
+    with pytest.raises(ValueError, match="strictly increasing"):
+        parse_buckets((4, 1), source="buckets")
+    with pytest.raises(ValueError, match="positive"):
+        parse_buckets((0, 4), source="buckets")
+
+
+def test_delay_negative_and_garbage_raise():
+    with pytest.raises(ValueError, match="DPTPU_SERVE_MAX_DELAY_MS"):
+        serve_knobs(environ={"DPTPU_SERVE_MAX_DELAY_MS": "-1"})
+    with pytest.raises(ValueError, match="DPTPU_SERVE_MAX_DELAY_MS"):
+        serve_knobs(environ={"DPTPU_SERVE_MAX_DELAY_MS": "soon"})
+    with pytest.raises(ValueError, match="--max-delay-ms"):
+        serve_knobs(max_delay_ms=-0.5)
+    # 0 is a VALID budget: dispatch immediately, never coalesce
+    assert serve_knobs(max_delay_ms=0.0).max_delay_ms == 0.0
+
+
+def test_placement_names_raise(monkeypatch):
+    with pytest.raises(ValueError, match="DPTPU_SERVE_PLACEMENT"):
+        serve_knobs(environ={"DPTPU_SERVE_PLACEMENT": "sharded"})
+    with pytest.raises(ValueError, match="--placement"):
+        serve_knobs(placement="sharded")
+
+
+def test_slots_validated():
+    with pytest.raises(ValueError, match="DPTPU_SERVE_SLOTS"):
+        serve_knobs(environ={"DPTPU_SERVE_SLOTS": "1"})
+    with pytest.raises(ValueError, match="--slots"):
+        serve_knobs(slots=0)
+
+
+def test_cli_parse_and_unknown_arch():
+    p = build_serve_parser()
+    args = p.parse_args(["-a", "resnet18", "--buckets", "1,8",
+                         "--max-delay-ms", "3", "--placement",
+                         "replicated"])
+    k = serve_args_to_knobs(args)
+    assert k.buckets == (1, 8) and k.max_delay_ms == 3.0
+    args = p.parse_args(["-a", "resnet999"])
+    with pytest.raises(ValueError, match="resnet999"):
+        serve_args_to_knobs(args)
+
+
+def test_cli_bad_knob_fails_before_any_engine(monkeypatch):
+    # the fail-fast moment is serve_args_to_knobs — a bad env knob must
+    # raise there even when every CLI flag is valid
+    monkeypatch.setenv("DPTPU_SERVE_BUCKETS", "16,4")
+    args = build_serve_parser().parse_args(["-a", "resnet18"])
+    with pytest.raises(ValueError, match="strictly increasing"):
+        serve_args_to_knobs(args)
+
+
+def test_engine_validates_placement_fail_fast():
+    # resolve_placement's impossible-request errors (no TP rule / one
+    # device) are part of the same pre-compile contract
+    from dptpu.serve import resolve_placement
+
+    with pytest.raises(ValueError, match="no tensor-parallel"):
+        resolve_placement("resnet18", "tp", device_count=8)
+    with pytest.raises(ValueError, match=">= 2 devices"):
+        resolve_placement("vit_b_16", "tp", device_count=1)
+    assert resolve_placement("vit_b_16", "auto", device_count=8) == "tp"
+    assert resolve_placement("resnet18", "auto", device_count=8) == \
+        "replicated"
+    assert resolve_placement("vit_b_16", "auto", device_count=1) == \
+        "replicated"

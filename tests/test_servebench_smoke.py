@@ -1,0 +1,56 @@
+"""Tier-1 smoke of scripts/run_servebench.py (the pattern of
+test_obsbench_smoke.py): the serving stack's latency/throughput curves,
+bucket accounting, padded-parity gate and tail gate are continuously
+checked — one subprocess, smallest preset, same gate logic as the
+committed SERVEBENCH.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_servebench_smoke_gates(tmp_path):
+    out = str(tmp_path / "SERVEBENCH.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # real single-CPU topology, like the obsbench smoke: the fake
+    # 8-device pod the conftest forces is a training-suite fixture; the
+    # serving gates being smoked are topology-independent
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_servebench.py"),
+         "--smoke", "--out", out],
+        capture_output=True, text=True, timeout=480, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"servebench gate failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+    with open(out) as f:
+        bench = json.load(f)
+    # the acceptance contract: padded-bucket serving is logit-identical
+    # to the single-request path, EXACTLY
+    assert bench["parity_max_abs_dlogit"] == 0.0
+    assert bench["gates"]["parity_ok"] and bench["gates"]["tail_ok"]
+    # both load models produced complete points
+    for point in list(bench["closed_loop"].values()) \
+            + list(bench["open_loop"].values()):
+        assert point["requests"] > 0
+        assert point["p50_ms"] <= point["p99_ms"] <= point["max_ms"]
+        # every dispatched batch is accounted to a configured bucket
+        assert all(int(b) in bench["buckets"]
+                   for b in point["bucket_counts"])
+        assert 0.0 <= point["padding_waste"] < 1.0
+    # open-loop points record what was offered (the load model's knob)
+    assert all("offered_qps" in p for p in bench["open_loop"].values())
+    assert bench["saturation_qps"] > 0
+    # the tail gate is evaluated at the SLO-typical 0.5x-saturation point
+    assert bench["tail_gate"]["at_offered_frac"] == 0.5
+    assert bench["tail_gate"]["p99_ms"] <= bench["tail_gate"]["budget_ms"]
