@@ -66,6 +66,11 @@ class Config:
     # saves only, the reference's behavior (imagenet_ddp.py:216-222).
     ckpt_steps: int = 0
     ckpt_keep: int = 3
+    # checkpoint destination: a directory OR a store URL (file:// /
+    # http(s)://) routed through dptpu.data.store — object-store
+    # checkpointing with the same CRC-footer + fallback-scan contract.
+    # Empty keeps the legacy default (CWD; apex: the TB run dir).
+    ckpt_dir: str = ""
     # large-batch training engine (dptpu extension, all variants):
     # optimizer recipe, gradient-accumulation microbatching, warmup
     # schedule and label smoothing (dptpu/ops/optimizers.py,
@@ -157,6 +162,11 @@ def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentPar
                         "one final mid-epoch save)")
     p.add_argument("--ckpt-keep", default=3, type=int, metavar="K",
                    help="how many rotated mid-epoch checkpoints to keep")
+    p.add_argument("--ckpt-dir", default="", type=str, metavar="DIR_OR_URL",
+                   help="where checkpoints go: a directory or a store "
+                        "URL (file:// or http(s)://, dptpu.data.store) — "
+                        "writes keep the CRC footer and --resume keeps "
+                        "the corrupt-fallback scan either way")
     # dptpu large-batch extension (not reference flags): the
     # ImageNet-in-minutes recipe — LARS/LAMB trust-ratio optimizers,
     # emulated large batches via gradient accumulation, linear-warmup +

@@ -12,7 +12,22 @@ from dptpu.data.cache import DecodeCache
 from dptpu.data.dataset import ImageFolderDataset, SyntheticDataset
 from dptpu.data.loader import DataLoader, DevicePrefetcher
 from dptpu.data.sampler import ShardedSampler
+from dptpu.data.shards import (
+    ShardLocalitySampler,
+    ShardSet,
+    verify_shard,
+    write_shards,
+)
 from dptpu.data.shm_cache import ShmDecodeCache
+from dptpu.data.store import (
+    HTTPStore,
+    LocalStore,
+    ShardByteCache,
+    Store,
+    is_store_url,
+    open_store,
+)
+from dptpu.data.stream import ShardStreamDataset
 from dptpu.data.transforms import (
     center_crop,
     random_horizontal_flip,
@@ -26,10 +41,21 @@ __all__ = [
     "DataLoader",
     "DecodeCache",
     "DevicePrefetcher",
+    "HTTPStore",
     "ImageFolderDataset",
+    "LocalStore",
+    "ShardByteCache",
+    "ShardLocalitySampler",
+    "ShardSet",
+    "ShardStreamDataset",
     "ShardedSampler",
     "ShmDecodeCache",
+    "Store",
     "SyntheticDataset",
+    "is_store_url",
+    "open_store",
+    "verify_shard",
+    "write_shards",
     "center_crop",
     "random_horizontal_flip",
     "random_resized_crop",

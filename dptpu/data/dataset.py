@@ -38,6 +38,94 @@ def _copy_checked(out: np.ndarray, img, index: int):
     np.copyto(out, img)
 
 
+def native_decode_sample(read_bytes, is_jpeg, transform, rng,
+                         decode_cache=None, cache_key=None, out=None):
+    """The fused libjpeg decode-crop-resize path from ENCODED BYTES —
+    shared by :class:`ImageFolderDataset` (bytes = the file) and the
+    packed-shard streaming dataset (bytes = a shard extent), so the two
+    sources produce bit-identical pixels by construction. ``read_bytes``
+    is a thunk: with a decode cache attached, a cache hit never fetches
+    the encoded bytes at all. Returns the decoded array, or None when
+    this sample/environment can't take the native path (caller falls
+    back to PIL). Transforms may veto via ``native_ok = False``
+    (ValTransform does — see its docstring)."""
+    if transform is None or not hasattr(transform, "sample") \
+            or not getattr(transform, "native_ok", True) or not is_jpeg:
+        return None
+    from dptpu.data import native_image
+
+    if not native_image.available():
+        return None
+    if decode_cache is not None:
+        rng_state = rng.bit_generator.state
+
+        def _resample(full):
+            # identical for a hit (cached view, in place — zero-copy
+            # even out of the pooled /dev/shm slab) and a miss (the
+            # freshly decoded buffer): same pixels, same rng draw.
+            # IDEMPOTENT by contract: the pooled cache's lock-free
+            # hit path may run this on a torn view and then retry or
+            # fall back to the miss path, so the rng state consumed
+            # by sample() is restored on every entry — the crop that
+            # finally lands is always the (seed, epoch, index) one.
+            rng.bit_generator.state = rng_state
+            h, w = full.shape[:2]
+            box, flip = transform.sample(w, h, rng)
+            return native_image.crop_resize(
+                full, box, transform.size, flip, out=out
+            )
+
+        hit, res = decode_cache.with_entry(cache_key, _resample)
+        if hit:
+            return res
+        data = read_bytes()
+        dims = native_image.jpeg_dims(data)
+        if dims is None:
+            return None
+        full = np.empty((dims[1], dims[0], 3), np.uint8)
+        if not native_image.decode_into_cache(data, full):
+            return None
+        decode_cache.put(cache_key, full)
+        return _resample(full)
+    data = read_bytes()
+    dims = native_image.jpeg_dims(data)
+    if dims is None:
+        return None
+    box, flip = transform.sample(dims[0], dims[1], rng)
+    return native_image.decode_crop_resize(
+        data, box, transform.size, flip, out=out
+    )
+
+
+def pil_decode_sample(read_bytes, transform, rng, decode_cache=None,
+                      cache_key=None):
+    """The PIL fallback path from encoded bytes (same sharing story as
+    :func:`native_decode_sample`; PIL decodes a BytesIO of the file's
+    bytes to the identical pixels it decodes from the file itself)."""
+    import io
+
+    from PIL import Image
+
+    if decode_cache is not None:
+        arr = decode_cache.get(cache_key)
+        if arr is None:
+            with Image.open(io.BytesIO(read_bytes())) as img:
+                arr = np.asarray(img.convert("RGB"))
+            decode_cache.put(cache_key, arr)
+        if transform is None:
+            # callers own (and may mutate) what get() returns — hand
+            # out a copy, never the shared cached buffer
+            return arr.copy()
+        # re-applying the transform to the cached full decode is
+        # bit-identical to the uncached PIL path (same source pixels)
+        return transform(Image.fromarray(arr), rng)
+    with Image.open(io.BytesIO(read_bytes())) as img:
+        img = img.convert("RGB")
+        if transform is None:
+            return np.asarray(img)
+        return transform(img, rng)
+
+
 class ImageFolderDataset:
     """root/<class_name>/<image> layout, torchvision class-index semantics.
 
@@ -109,84 +197,31 @@ class ImageFolderDataset:
     def __len__(self) -> int:
         return len(self.samples)
 
+    @staticmethod
+    def _read_file(path: str):
+        def read_bytes():
+            with open(path, "rb") as f:
+                return f.read()
+        return read_bytes
+
     def _native_decode(self, path: str, rng, out=None):
         """Fused libjpeg decode-crop-resize into ``out`` (or a fresh
-        array); None when this sample/environment can't take the path.
-        Transforms may veto it with ``native_ok = False`` (ValTransform
-        does: the fast path's scaled decode + 2-tap lerp is augmentation
-        -grade, not validation-grade — see its docstring)."""
-        if self.transform is None or not hasattr(self.transform, "sample") \
-                or not getattr(self.transform, "native_ok", True) \
-                or not path.lower().endswith((".jpg", ".jpeg")):
-            return None
-        from dptpu.data import native_image
-
-        if not native_image.available():
-            return None
-        if self.decode_cache is not None:
-            rng_state = rng.bit_generator.state
-
-            def _resample(full):
-                # identical for a hit (cached view, in place — zero-copy
-                # even out of the pooled /dev/shm slab) and a miss (the
-                # freshly decoded buffer): same pixels, same rng draw.
-                # IDEMPOTENT by contract: the pooled cache's lock-free
-                # hit path may run this on a torn view and then retry or
-                # fall back to the miss path, so the rng state consumed
-                # by sample() is restored on every entry — the crop that
-                # finally lands is always the (seed, epoch, index) one.
-                rng.bit_generator.state = rng_state
-                h, w = full.shape[:2]
-                box, flip = self.transform.sample(w, h, rng)
-                return native_image.crop_resize(
-                    full, box, self.transform.size, flip, out=out
-                )
-
-            hit, res = self.decode_cache.with_entry(("native", path),
-                                                    _resample)
-            if hit:
-                return res
-            with open(path, "rb") as f:
-                data = f.read()
-            dims = native_image.jpeg_dims(data)
-            if dims is None:
-                return None
-            full = np.empty((dims[1], dims[0], 3), np.uint8)
-            if not native_image.decode_into_cache(data, full):
-                return None
-            self.decode_cache.put(("native", path), full)
-            return _resample(full)
-        with open(path, "rb") as f:
-            data = f.read()
-        dims = native_image.jpeg_dims(data)
-        if dims is None:
-            return None
-        box, flip = self.transform.sample(dims[0], dims[1], rng)
-        return native_image.decode_crop_resize(
-            data, box, self.transform.size, flip, out=out
+        array); None when this sample/environment can't take the path
+        (see :func:`native_decode_sample` — the bytes-level
+        implementation shared with the packed-shard dataset)."""
+        return native_decode_sample(
+            self._read_file(path),
+            path.lower().endswith((".jpg", ".jpeg")),
+            self.transform, rng,
+            decode_cache=self.decode_cache, cache_key=("native", path),
+            out=out,
         )
 
     def _pil_decode(self, path: str, rng):
-        from PIL import Image
-
-        if self.decode_cache is not None:
-            arr = self.decode_cache.get(("pil", path))
-            if arr is None:
-                with Image.open(path) as img:
-                    arr = np.asarray(img.convert("RGB"))
-                self.decode_cache.put(("pil", path), arr)
-            if self.transform is None:
-                # callers own (and may mutate) what get() returns — hand
-                # out a copy, never the shared cached buffer
-                return arr.copy()
-            # re-applying the transform to the cached full decode is
-            # bit-identical to the uncached PIL path (same source pixels)
-            return self.transform(Image.fromarray(arr), rng)
-        with Image.open(path) as img:
-            img = img.convert("RGB")
-            if self.transform is None:
-                return np.asarray(img)
-            return self.transform(img, rng)
+        return pil_decode_sample(
+            self._read_file(path), self.transform, rng,
+            decode_cache=self.decode_cache, cache_key=("pil", path),
+        )
 
     def get(self, index: int, rng: Optional[np.random.Generator] = None):
         """Load + transform one sample; ``rng`` drives any augmentation

@@ -162,6 +162,11 @@ class DataLoader:
         )
         self._get = getattr(dataset, "get", None)
         self._get_into = getattr(dataset, "get_into", None)
+        # shard-streaming hook (dptpu/data/stream.py): the dataset owns
+        # its I/O engine; pre-issue stages extents into the byte slab.
+        # Thread mode calls it at submit time; process mode routes it
+        # through the shm pipeline's pre-issue pump.
+        self._prefetch_extents = getattr(dataset, "prefetch_extents", None)
         self._item_shape = None  # probed from the first sample
         self._probe = None  # (index, epoch, img, label) — reused for row 0
         self._pipeline = None  # lazy shm ring (process mode)
@@ -226,6 +231,10 @@ class DataLoader:
         per image: HOSTBENCH r4 measured the per-image dispatch +
         intermediate memcpy at ~19% of a decode core."""
         n_valid = len(batch_indices)
+        if self._prefetch_extents is not None and self.readahead:
+            # stage this batch's shard extents now — it decodes
+            # ``prefetch_batches`` from now, so the bytes land first
+            self._prefetch_extents(batch_indices)
         out_size = self.batch_size if self.pad_final else n_valid
         imgs = np.empty((out_size,) + self._item_shape, np.uint8)
         labels = np.zeros((out_size,), np.int32)
@@ -562,6 +571,35 @@ class DataLoader:
             cache = getattr(self.dataset, "decode_cache", None)
             if cache is not None:
                 stats.update(cache.stats())
+        # shard-streaming telemetry (dptpu/data/stream.py): byte-ring /
+        # store-fetch counters, plus the I/O-ownership invariant. The
+        # fadvise readahead and the shard engine must NEVER both be
+        # armed — WILLNEED would repopulate the page cache the O_DIRECT
+        # ring exists to bypass — so feed_stats ASSERTS the exclusion
+        # rather than just reporting it.
+        io_fn = getattr(self.dataset, "io_stats", None)
+        shard_owns_io = self._prefetch_extents is not None
+        fadvise_active = (
+            self.readahead and not shard_owns_io
+            and self.workers_mode == "process"
+            and getattr(self.dataset, "samples", None) is not None
+        )
+        if shard_owns_io and self.readahead \
+                and getattr(self.dataset, "samples", None) is not None:
+            raise RuntimeError(
+                "feed invariant violated: the dataset exposes BOTH "
+                "prefetch_extents (shard engine owns the I/O) and a "
+                "samples path list (the fadvise readahead target) — "
+                "the two byte-prefetch paths must be mutually exclusive"
+            )
+        stats["readahead_active"] = fadvise_active
+        if io_fn is not None:
+            stats.update(io_fn())
+            assert not (stats["readahead_active"]
+                        and stats.get("odirect_active")), (
+                "fadvise readahead and the O_DIRECT shard ring are both "
+                "active — mutually exclusive by contract"
+            )
         if "cache_hits" in stats:
             dh = stats["cache_hits"] - self._prev_cache_counts[0]
             dm = stats["cache_misses"] - self._prev_cache_counts[1]

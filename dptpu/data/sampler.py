@@ -46,6 +46,17 @@ class ShardedSampler:
         """This shard's index slice for ``epoch`` (set_epoch analog)."""
         return self.indices_and_validity(epoch)[0]
 
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """The full-dataset visit order for ``epoch`` — a pure function
+        of ``(seed, epoch)`` (the resilience replay contract). Subclasses
+        may reorder (e.g. the packed-shard locality sampler,
+        dptpu/data/shards.py) but must stay pure in the same inputs."""
+        if self.shuffle:
+            return np.random.RandomState(self.seed + epoch).permutation(
+                self.num_examples
+            )
+        return np.arange(self.num_examples)
+
     def indices_and_validity(self, epoch: int = 0):
         """``(indices, valid)`` for this shard and ``epoch``.
 
@@ -56,12 +67,7 @@ class ShardedSampler:
         (imagenet_ddp_apex.py:457-460) must not count the duplicated
         samples twice, so the loader zeroes their mask entries.
         """
-        if self.shuffle:
-            order = np.random.RandomState(self.seed + epoch).permutation(
-                self.num_examples
-            )
-        else:
-            order = np.arange(self.num_examples)
+        order = self._epoch_order(epoch)
         total = self.samples_per_shard * self.num_shards
         valid = np.ones(max(total, order.size), np.bool_)
         if total > order.size:  # pad by wrap-around (DistributedSampler)

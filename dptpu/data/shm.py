@@ -413,9 +413,19 @@ class ShmBatchPipeline:
         # MB of parent RSS for the pipeline's lifetime
         self._readahead = readahead
         self._sample_paths = getattr(dataset, "samples", None)
+        # a STREAMING shard dataset owns its own I/O engine (O_DIRECT
+        # ring / store range fetch into the /dev/shm byte slab,
+        # dptpu/data/stream.py): pre-issue routes to its
+        # ``prefetch_extents`` INSTEAD of fadvise — WILLNEED would
+        # repopulate the page cache the O_DIRECT ring just bypassed.
+        # Such datasets expose no ``samples`` path list, so the two
+        # paths are mutually exclusive by construction (and asserted in
+        # DataLoader.feed_stats).
+        self._prefetch_extents = getattr(dataset, "prefetch_extents", None)
         self._readahead_done = (
             bytearray(len(self._sample_paths))
-            if self._sample_paths is not None else None
+            if self._sample_paths is not None
+            and self._prefetch_extents is None else None
         )
         self._closed = False
         self._start_workers()
@@ -504,7 +514,15 @@ class ShmBatchPipeline:
         worker that decodes them ``decode_ahead`` batches from now finds
         the bytes already in the page cache. Each path is advised once
         per pipeline — after the first epoch the cache is as warm as it
-        will get and repeated advice is pure syscall overhead."""
+        will get and repeated advice is pure syscall overhead.
+
+        Shard-streaming datasets take the OTHER branch: their extents
+        are staged into the /dev/shm byte slab by their own engine
+        (every pre-issue, not once — the slab evicts), and fadvise
+        never runs."""
+        if self._prefetch_extents is not None:
+            self._prefetch_extents(batch_indices)
+            return
         samples = self._sample_paths
         if samples is None:
             return

@@ -190,7 +190,8 @@ class ShmDecodeCache:
     scope = "pooled"
 
     def __init__(self, budget_bytes: int, n_stripes: int = 64,
-                 max_entries: int = 0, lock_timeout_s: float = 2.0):
+                 max_entries: int = 0, lock_timeout_s: float = 2.0,
+                 segment_prefix: str = SEGMENT_PREFIX):
         if budget_bytes <= 0:
             raise ValueError(
                 f"cache budget must be positive, got {budget_bytes} "
@@ -226,8 +227,12 @@ class ShmDecodeCache:
                       + max_entries * _E_LEN + max_entries) * 8
         meta_bytes = -(-meta_bytes // _ALIGN) * _ALIGN
         self._arena_off = meta_bytes
+        # the prefix is the /dev/shm attribution tag: decoded-pixel slabs
+        # keep "dptpu_cache", the shard BYTE cache (dptpu/data/store.py)
+        # passes "dptpu_shard" so the conftest leak guard can tell them
+        # apart
         self._shm = create_named_segment(
-            SEGMENT_PREFIX, meta_bytes + self.budget_bytes
+            segment_prefix, meta_bytes + self.budget_bytes
         )
         self.segment_name = self._shm.name
         self._map_views()
@@ -394,6 +399,19 @@ class ShmDecodeCache:
             return arr
         finally:
             self._release(lock, 2 + stripe)
+
+    def contains(self, key) -> bool:
+        """READY-entry existence check without the copy-out (the shard
+        prefetcher's already-staged test — a get() would memcpy the
+        whole payload just to throw it away). Lock-free like
+        ``with_entry``'s scan: a torn race reads as absent, which only
+        costs a redundant re-stage."""
+        if self._closed:
+            return False
+        lo, hi = _digest128(key)
+        a, b = self._stripe_range(self._stripe_of(lo))
+        return self._scan(self._entries[a:b], _signed64(lo), _signed64(hi),
+                          ready_only=True) >= 0
 
     def with_entry(self, key, fn):
         """ZERO-COPY LOCK-FREE hit path: run ``fn(view)`` on the cached

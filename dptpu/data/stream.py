@@ -1,0 +1,725 @@
+"""Streaming shard I/O: O_DIRECT byte ring, extent prefetch, ShardStreamDataset.
+
+The local engine is an O_DIRECT double-buffered aligned byte ring:
+shard extents are read with the page cache BYPASSED, so cold-epoch
+throughput no longer depends on the dataset fitting in RAM — the exact
+production case the shipped ``posix_fadvise`` readahead cannot help
+(it only warms a cache the dataset immediately evicts). Reads go
+through 4 KiB-aligned buffers at aligned offsets; the prefetcher keeps
+TWO of them in flight (read extent k+1 while extent k is being copied
+into the pooled staging slab) so the disk never idles behind the copy.
+Filesystems that refuse O_DIRECT (tmpfs, some overlayfs) are detected
+at open/first-read time and fall back to plain ``pread`` — recorded in
+``io_stats`` (``odirect_active`` / ``odirect_why``), never silent.
+
+The remote engine is the :class:`~dptpu.data.store.Store` range
+fetcher: the same prefetcher pulls coalesced extent ranges (or whole
+shards, ``DPTPU_STORE_FETCH=shard``) over HTTP with retry/backoff.
+
+Both engines stage bytes into the POOLED ``/dev/shm`` slab
+(:class:`~dptpu.data.store.ShardByteCache` — the PR 3 decode-cache
+machinery reused byte-for-byte): the PARENT's prefetcher writes extents
+in at span pre-issue time (the decode-ahead pump's moment), and every
+DECODE WORKER reads them out — O_DIRECT bypasses the page cache, so the
+slab IS the hand-off between the process that reads and the processes
+that decode. A worker that misses (cold start, eviction) reads its own
+extent directly; every fetched extent is CRC-verified against the
+shard index before a single byte is decoded.
+
+:class:`ShardStreamDataset` is the ImageFolder drop-in over a packed
+split (local dir, ``file://`` or ``http(s)://``): same
+``get``/``get_into`` surface, same transforms, same decode-cache knobs
+— and the same ``(seed, epoch, index)`` bit-identity contract, because
+the extents hold the source files' exact bytes and decode goes through
+the SAME code paths (dptpu/data/dataset.py's bytes-level helpers).
+It deliberately exposes NO ``samples`` path list — the shm pipeline's
+``posix_fadvise`` readahead therefore never arms — and instead exposes
+``prefetch_extents``, which the loader calls at the same pre-issue
+moment; the two I/O paths are mutually exclusive by construction (and
+asserted in ``feed_stats``).
+
+Env knobs (fail-fast, the locked contract):
+
+* ``DPTPU_SHARD_CACHE_BYTES`` — staging slab budget (default 128 MiB;
+  0 disables staging: every read is direct);
+* ``DPTPU_ODIRECT`` — use O_DIRECT for local shard reads when the
+  filesystem supports it (default on; off forces plain ``pread``);
+* ``DPTPU_STORE_FETCH`` — remote prefetch granularity: ``extent``
+  (coalesced ranges, default) or ``shard`` (whole data region on first
+  touch).
+
+Worker-safe: stdlib + numpy only, never JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import weakref
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dptpu.data.shards import (
+    IDX_CRC,
+    IDX_FLAGS,
+    IDX_LABEL,
+    IDX_LEN,
+    IDX_OFF,
+    FLAG_JPEG,
+    ShardSet,
+    verify_sample,
+)
+from dptpu.data.store import (
+    LocalStore,
+    ShardByteCache,
+    Store,
+    open_store,
+)
+from dptpu.envknob import env_bool, env_choice, env_int
+
+ALIGN = 4096
+_COALESCE_GAP = 64 << 10  # merge extents closer than this into one read
+_MAX_RANGE = 8 << 20  # cap one coalesced read (bounds buffer + latency)
+
+# open shard fds in THIS process — the conftest leak guard's census
+_OPEN_READERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def open_fd_count() -> int:
+    """Shard-file descriptors still open in this process (the conftest
+    session guard fails the suite when a dataset leaks them past
+    ``close()``)."""
+    return sum(1 for r in list(_OPEN_READERS) if r._fd is not None)
+
+
+def _aligned_buffer(nbytes: int):
+    """``(keepalive, view)`` where ``view`` is an ALIGN-aligned uint8
+    array of ``nbytes`` — the O_DIRECT user-buffer requirement."""
+    raw = np.empty(nbytes + ALIGN, np.uint8)
+    off = (-raw.ctypes.data) % ALIGN
+    return raw, raw[off:off + nbytes]
+
+
+class ShardFileReader:
+    """One shard file, read via O_DIRECT when the filesystem allows it
+    (probed at open AND first read — some filesystems accept the open
+    flag and fail the read) with a plain-``pread`` fallback. Lazy open,
+    per process; never pickled (the engine recreates readers post-
+    spawn)."""
+
+    def __init__(self, path: str, want_odirect: bool = True):
+        self.path = path
+        self.want_odirect = want_odirect and hasattr(os, "O_DIRECT")
+        self._fd: Optional[int] = None
+        self.odirect = False
+        self.odirect_why = ""
+        self._lock = threading.Lock()
+        _OPEN_READERS.add(self)
+
+    def _ensure_open(self):
+        if self._fd is not None:
+            return
+        if self.want_odirect:
+            try:
+                self._fd = os.open(self.path, os.O_RDONLY | os.O_DIRECT)
+                self.odirect = True
+                return
+            except OSError as e:
+                self.odirect_why = (
+                    f"O_DIRECT open refused by the filesystem ({e}); "
+                    f"plain read() fallback"
+                )
+        elif not hasattr(os, "O_DIRECT"):
+            self.odirect_why = "platform has no O_DIRECT"
+        elif not self.want_odirect:
+            self.odirect_why = "disabled (DPTPU_ODIRECT=0)"
+        self._fd = os.open(self.path, os.O_RDONLY)
+        self.odirect = False
+
+    def _fall_back(self, why: str):
+        if self._fd is not None:
+            os.close(self._fd)
+        self._fd = os.open(self.path, os.O_RDONLY)
+        self.odirect = False
+        self.odirect_why = why
+
+    def read_range(self, offset: int, length: int,
+                   buf: Optional[np.ndarray] = None) -> bytes:
+        """``length`` bytes at ``offset`` — via an aligned enclosing
+        O_DIRECT read (into ``buf`` when provided and big enough: the
+        prefetcher's double-buffer) or a plain pread."""
+        with self._lock:
+            self._ensure_open()
+            if self.odirect:
+                a0 = (offset // ALIGN) * ALIGN
+                need = -(-(offset + length - a0) // ALIGN) * ALIGN
+                if buf is None or buf.size < need:
+                    _keep, view = _aligned_buffer(need)
+                else:
+                    view = buf[:need]
+                got = 0
+                try:
+                    while got < need:
+                        n = os.preadv(self._fd, [view[got:need]], a0 + got)
+                        if n <= 0:
+                            break  # EOF
+                        got += n
+                except OSError as e:
+                    # the open accepted O_DIRECT but the read refused it
+                    # (overlayfs quirk): fall back for the file's lifetime
+                    self._fall_back(
+                        f"O_DIRECT read failed ({e}); plain read() "
+                        f"fallback"
+                    )
+                    return self._plain_read(offset, length)
+                if got < (offset - a0) + length:
+                    raise OSError(
+                        f"{self.path}: short read — wanted "
+                        f"[{offset}:{offset + length}) but the aligned "
+                        f"read ended {got} bytes after {a0} (truncated "
+                        f"shard?)"
+                    )
+                lo = offset - a0
+                return view[lo:lo + length].tobytes()
+            return self._plain_read(offset, length)
+
+    def _plain_read(self, offset: int, length: int) -> bytes:
+        out = bytearray()
+        while len(out) < length:
+            chunk = os.pread(self._fd, length - len(out),
+                             offset + len(out))
+            if not chunk:
+                raise OSError(
+                    f"{self.path}: short read at {offset + len(out)} "
+                    f"(wanted {length} bytes; truncated shard?)"
+                )
+            out.extend(chunk)
+        return bytes(out)
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _coalesce(extents: List[Tuple[int, int, int]],
+              max_range: int = _MAX_RANGE,
+              gap: int = _COALESCE_GAP):
+    """Merge per-sample extents ``(offset, length, tag)`` (sorted by
+    offset) into read ranges ``(range_off, range_len, [members])`` —
+    sequential I/O instead of one syscall/request per sample."""
+    out = []
+    cur_off = cur_end = None
+    members: list = []
+    for off, length, tag in sorted(extents):
+        if cur_off is not None and off - cur_end <= gap \
+                and (off + length) - cur_off <= max_range:
+            cur_end = max(cur_end, off + length)
+            members.append((off, length, tag))
+            continue
+        if cur_off is not None:
+            out.append((cur_off, cur_end - cur_off, members))
+        cur_off, cur_end = off, off + length
+        members = [(off, length, tag)]
+    if cur_off is not None:
+        out.append((cur_off, cur_end - cur_off, members))
+    return out
+
+
+class ShardIOEngine:
+    """Per-process byte source for a packed split: resolves a global
+    sample index to its shard extent and fetches the bytes — staging
+    slab first, then the local O_DIRECT/pread reader or the remote
+    store range fetch. The PARENT additionally runs the prefetcher
+    (:meth:`prefetch`) that fills the slab ahead of the decode
+    workers."""
+
+    def __init__(self, shard_set: ShardSet, byte_cache: Optional[
+                 ShardByteCache], cache_tag: str, odirect: bool = True,
+                 fetch_mode: str = "extent"):
+        self.shard_set = shard_set
+        self.byte_cache = byte_cache
+        self.cache_tag = cache_tag
+        self.odirect_wanted = odirect
+        self.fetch_mode = fetch_mode
+        self.store = shard_set.store
+        self._local = isinstance(self.store, LocalStore)
+        self._readers: dict = {}
+        self._whole_fetched: set = set()
+        self._prefetcher: Optional[_ExtentPrefetcher] = None
+        self._lock = threading.Lock()
+        # telemetry (this process)
+        self.bytes_read = 0
+        self.extents_read = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- byte sources -------------------------------------------------------
+
+    def _reader(self, shard_id: int) -> ShardFileReader:
+        r = self._readers.get(shard_id)
+        if r is None:
+            path = self.store.path_for(self.shard_set.shard_names[shard_id])
+            r = ShardFileReader(path, want_odirect=self.odirect_wanted)
+            self._readers[shard_id] = r
+        return r
+
+    def _fetch_range(self, shard_id: int, offset: int, length: int,
+                     buf: Optional[np.ndarray] = None) -> bytes:
+        if self._local:
+            data = self._reader(shard_id).read_range(offset, length, buf)
+        else:
+            data = self.store.get_range(
+                self.shard_set.shard_names[shard_id], offset, length
+            )
+            if len(data) < length:
+                raise OSError(
+                    f"{self.shard_set.shard_names[shard_id]}: range "
+                    f"fetch returned {len(data)} of {length} bytes"
+                )
+        with self._lock:
+            self.bytes_read += length
+            self.extents_read += 1
+        return data
+
+    def _cache_key(self, shard_id: int, pos: int):
+        return ("dpts", self.cache_tag, shard_id, pos)
+
+    def read_sample(self, gidx: int) -> Tuple[bytes, int, bool]:
+        """``(encoded bytes, label, is_jpeg)`` for global index ``gidx``
+        — slab hit, or a direct (CRC-verified) read."""
+        shard_id, pos = self.shard_set.locate(gidx)
+        _hdr, idx = self.shard_set.shard_table(shard_id)
+        row = idx[pos]
+        return (self.read_row(shard_id, pos),
+                int(row[IDX_LABEL]),
+                bool(int(row[IDX_FLAGS]) & FLAG_JPEG))
+
+    def read_row(self, shard_id: int, pos: int) -> bytes:
+        """The encoded bytes for one ALREADY-RESOLVED extent — callers
+        that looked the extent up for its metadata (the dataset's
+        decode path) fetch through here so the locate/row resolution
+        never runs twice per sample."""
+        hdr, idx = self.shard_set.shard_table(shard_id)
+        row = idx[pos]
+        length = int(row[IDX_LEN])
+        key = self._cache_key(shard_id, pos)
+        if self.byte_cache is not None and not self.byte_cache.closed:
+            data = self.byte_cache.get(key, length)
+            if data is not None:
+                with self._lock:
+                    self.cache_hits += 1
+                # slab entries were CRC-verified on fill; verify again
+                # anyway — the check is cheap and a torn slab read
+                # must never reach the decoder
+                return verify_sample(
+                    data, int(row[IDX_CRC]),
+                    self.shard_set.shard_names[shard_id], pos,
+                )
+            with self._lock:
+                self.cache_misses += 1
+        data = self._fetch_range(
+            shard_id, hdr["data_off"] + int(row[IDX_OFF]), length
+        )
+        # deliberately NO put-on-miss: each sample is consumed once per
+        # epoch, so staging a consumer's own miss helps nobody — only
+        # the parent prefetcher (which stages AHEAD of consumption)
+        # writes the slab
+        return verify_sample(data, int(row[IDX_CRC]),
+                             self.shard_set.shard_names[shard_id], pos)
+
+    # -- prefetch (parent side) ---------------------------------------------
+
+    def prefetch(self, indices) -> None:
+        """Queue upcoming samples' extents for background staging into
+        the slab — the loader calls this at span pre-issue time, so the
+        bytes land ``decode_ahead`` batches before a worker asks. No-op
+        without a staging slab (nowhere to put the bytes)."""
+        if self.byte_cache is None:
+            return
+        if self._prefetcher is None:
+            self._prefetcher = _ExtentPrefetcher(self)
+        self._prefetcher.enqueue([int(i) for i in indices])
+
+    def _stage_batch(self, indices: List[int]):
+        """Resolve indices to extents, coalesce per shard, fetch each
+        range (double-buffered on the local O_DIRECT path), slice the
+        member extents out and put them into the slab. Runs on the
+        prefetcher thread."""
+        from dptpu import obs
+
+        by_shard: dict = {}
+        for g in indices:
+            shard_id, pos = self.shard_set.locate(g)
+            hdr, idx = self.shard_set.shard_table(shard_id)
+            row = idx[pos]
+            if self.byte_cache.contains(self._cache_key(shard_id, pos)):
+                continue  # already staged
+            by_shard.setdefault(shard_id, []).append((
+                hdr["data_off"] + int(row[IDX_OFF]), int(row[IDX_LEN]),
+                (pos, int(row[IDX_CRC])),
+            ))
+        for shard_id, extents in by_shard.items():
+            if self.fetch_mode == "shard" and not self._local:
+                self._stage_whole_shard(shard_id)
+                continue
+            ranges = _coalesce(extents)
+            with obs.get_tracer().span("shard_fetch"):
+                self._stage_ranges(shard_id, ranges)
+
+    def _stage_ranges(self, shard_id: int, ranges):
+        """The double-buffered byte ring: while range k is being sliced
+        and copied into the slab, range k+1 is already being read into
+        the OTHER aligned buffer. The two buffers are PERSISTENT (grown
+        to the largest range seen, capped by the coalescer) — one
+        prefetch thread, strictly alternating, so reuse across calls
+        cannot race."""
+        need = max(length for _, length, _m in ranges) + 2 * ALIGN
+        bufs = getattr(self, "_ring_bufs", None)
+        if bufs is None or bufs[0][1].size < need:
+            bufs = self._ring_bufs = [
+                _aligned_buffer(need), _aligned_buffer(need),
+            ]
+        ex = self._range_executor()
+        nxt = None
+        for k, (off, length, members) in enumerate(ranges):
+            buf = bufs[k % 2][1]
+            fut = ex.submit(self._fetch_range, shard_id, off, length, buf)
+            if nxt is not None:
+                self._stage_members(shard_id, *nxt)
+            nxt = (fut, off, members)
+        if nxt is not None:
+            self._stage_members(shard_id, *nxt)
+
+    def _stage_members(self, shard_id: int, fut, range_off: int, members):
+        data = fut.result()
+        for off, length, (pos, crc) in members:
+            lo = off - range_off
+            payload = data[lo:lo + length]
+            try:
+                verify_sample(payload, crc,
+                              self.shard_set.shard_names[shard_id], pos)
+            except Exception:
+                continue  # the consumer's direct read surfaces it loudly
+            self.byte_cache.put(self._cache_key(shard_id, pos), payload)
+
+    def _range_executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not hasattr(self, "_range_pool"):
+            self._range_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="dptpu-shard-read"
+            )
+        return self._range_pool
+
+    def _stage_whole_shard(self, shard_id: int):
+        """Remote whole-shard mode: pull the full data region once and
+        populate every extent of the shard into the slab (skipped when
+        the shard exceeds half the slab budget — it would evict itself)."""
+        if shard_id in self._whole_fetched:
+            return
+        hdr, idx = self.shard_set.shard_table(shard_id)
+        budget = self.byte_cache._cache.budget_bytes
+        if hdr["data_len"] > budget // 2:
+            ranges = _coalesce([
+                (hdr["data_off"] + int(r[IDX_OFF]), int(r[IDX_LEN]),
+                 (int(p), int(r[IDX_CRC])))
+                for p, r in enumerate(idx)
+            ])
+            self._stage_ranges(shard_id, ranges)
+            return
+        data = self._fetch_range(shard_id, hdr["data_off"],
+                                 hdr["data_len"])
+        # mark AFTER the fetch succeeded: a failed first touch (remote
+        # flake past the retry budget) must stay retryable on the next
+        # prefetch, not silently demote the shard to per-extent direct
+        # reads for the rest of the run
+        self._whole_fetched.add(shard_id)
+        for pos in range(hdr["num_samples"]):
+            off, length = int(idx[pos, IDX_OFF]), int(idx[pos, IDX_LEN])
+            payload = data[off:off + length]
+            try:
+                verify_sample(payload, int(idx[pos, IDX_CRC]),
+                              self.shard_set.shard_names[shard_id], pos)
+            except Exception:
+                continue
+            self.byte_cache.put(self._cache_key(shard_id, pos), payload)
+
+    # -- telemetry / lifecycle ----------------------------------------------
+
+    def io_stats(self) -> dict:
+        with self._lock:
+            stats = {
+                "shard_streaming": True,
+                "shard_bytes_read": self.bytes_read,
+                "shard_extents_read": self.extents_read,
+                "shard_cache_hits": self.cache_hits,
+                "shard_cache_misses": self.cache_misses,
+            }
+        probe = next(iter(self._readers.values()), None)
+        if self._local:
+            stats["odirect_active"] = bool(probe and probe.odirect)
+            if probe is not None and not probe.odirect:
+                stats["odirect_why"] = probe.odirect_why
+        else:
+            stats["odirect_active"] = False
+            stats["odirect_why"] = "remote store (range fetch)"
+        stats.update(self.store.stats())
+        if self.byte_cache is not None and not self.byte_cache.closed:
+            stats.update(self.byte_cache.stats())
+        return stats
+
+    def close(self):
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        if hasattr(self, "_range_pool"):
+            self._range_pool.shutdown(wait=True)
+            del self._range_pool
+        for r in self._readers.values():
+            r.close()
+        self._readers.clear()
+
+
+class _ExtentPrefetcher:
+    """One background thread draining index batches into
+    :meth:`ShardIOEngine._stage_batch`. The queue is SHALLOW and lossy
+    (prefetch is advisory — a dropped batch just means the worker's own
+    direct read pays the latency instead)."""
+
+    def __init__(self, engine: ShardIOEngine, depth: int = 8):
+        self._engine = engine
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=depth)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dptpu-shard-prefetch"
+        )
+        self._thread.start()
+
+    def enqueue(self, indices: List[int]):
+        try:
+            self._q.put_nowait(indices)
+        except _queue.Full:
+            pass  # advisory: the consumer is ahead of the disk already
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._engine._stage_batch(item)
+            except Exception:
+                # prefetch must never kill the run: the consumer-side
+                # direct read will surface any real error with context
+                pass
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+
+def _shard_knobs(byte_cache_bytes, odirect, fetch_mode):
+    """The streaming knobs under the locked fail-fast contract."""
+    if byte_cache_bytes is None:
+        byte_cache_bytes = env_int("DPTPU_SHARD_CACHE_BYTES", 128 << 20)
+    if byte_cache_bytes < 0:
+        raise ValueError(
+            f"DPTPU_SHARD_CACHE_BYTES={byte_cache_bytes} must be >= 0 "
+            f"bytes (0 disables the staging slab)"
+        )
+    if odirect is None:
+        odirect = env_bool("DPTPU_ODIRECT", True)
+    if fetch_mode is None:
+        fetch_mode = env_choice(
+            "DPTPU_STORE_FETCH", ("extent", "shard"), default="extent"
+        )
+    elif fetch_mode not in ("extent", "shard"):
+        raise ValueError(
+            f"fetch_mode={fetch_mode!r} must be 'extent' or 'shard'"
+        )
+    return byte_cache_bytes, odirect, fetch_mode
+
+
+class ShardStreamDataset:
+    """ImageFolder-semantics dataset over a PACKED split (local path,
+    ``file://`` or ``http(s)://`` store URL): same classes/labels, same
+    transforms, same per-``(seed, epoch, index)`` pixels — streaming vs
+    ImageFolder batches are bit-identical by construction (locked by
+    tests and the DATABENCH gate). See the module docstring for the
+    I/O engine underneath.
+
+    ``cache_bytes``/``cache_scope`` attach the DECODED-pixel cache
+    exactly as on :class:`ImageFolderDataset`; ``byte_cache_bytes``
+    budgets the ENCODED-byte staging slab (``DPTPU_SHARD_CACHE_BYTES``).
+    """
+
+    def __init__(self, location: str, transform=None, cache_bytes: int = 0,
+                 cache_scope: str = "sharded",
+                 byte_cache_bytes: Optional[int] = None,
+                 odirect: Optional[bool] = None,
+                 fetch_mode: Optional[str] = None,
+                 store: Optional[Store] = None):
+        self.location = location
+        self.transform = transform
+        if cache_scope not in ("sharded", "pooled"):
+            raise ValueError(
+                f"cache_scope={cache_scope!r} must be 'sharded' or "
+                f"'pooled'"
+            )
+        if cache_bytes and cache_scope == "pooled":
+            from dptpu.data.shm_cache import ShmDecodeCache
+
+            self.decode_cache = ShmDecodeCache(cache_bytes)
+        elif cache_bytes:
+            from dptpu.data.cache import DecodeCache
+
+            self.decode_cache = DecodeCache(cache_bytes)
+        else:
+            self.decode_cache = None
+        byte_cache_bytes, odirect, fetch_mode = _shard_knobs(
+            byte_cache_bytes, odirect, fetch_mode
+        )
+        self._odirect = odirect
+        self._fetch_mode = fetch_mode
+        self.shard_set = ShardSet(store if store is not None
+                                  else open_store(location))
+        self.classes = self.shard_set.classes
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.byte_cache = (
+            ShardByteCache(byte_cache_bytes) if byte_cache_bytes else None
+        )
+        self._engine: Optional[ShardIOEngine] = None
+        self._closed = False
+
+    # NOTE deliberately NO ``samples`` attribute: the shm pipeline keys
+    # its posix_fadvise readahead off it, and the shard engine owns the
+    # I/O here (fadvise would repopulate the page cache O_DIRECT just
+    # bypassed). The loader routes ``prefetch_extents`` instead.
+
+    def __len__(self) -> int:
+        return self.shard_set.num_samples
+
+    def __getstate__(self):
+        # spawn boundary: workers rebuild their own engine (fds, HTTP
+        # connections and threads never cross); per-shard index tables
+        # re-fetch lazily so the pickle stays manifest-sized
+        state = dict(self.__dict__)
+        state["_engine"] = None
+        shard_set = state["shard_set"]
+        clone = ShardSet.__new__(ShardSet)
+        clone.__dict__ = dict(shard_set.__dict__)
+        clone._headers = {}
+        clone._indexes = {}
+        state["shard_set"] = clone
+        return state
+
+    def engine(self) -> ShardIOEngine:
+        if self._engine is None:
+            self._engine = ShardIOEngine(
+                self.shard_set, self.byte_cache, cache_tag=self.location,
+                odirect=self._odirect, fetch_mode=self._fetch_mode,
+            )
+        return self._engine
+
+    # -- the ImageFolder surface --------------------------------------------
+
+    def _decode(self, index: int, rng, out=None):
+        from dptpu.data.dataset import (
+            native_decode_sample,
+            pil_decode_sample,
+        )
+
+        engine = self.engine()
+        holder = {}
+        # extent metadata (label, jpeg flag) WITHOUT fetching bytes —
+        # the decode-cache hit path must not touch the store at all —
+        # and the resolved (shard, pos) rides into the byte thunk so
+        # the locate/row lookup never runs twice per sample
+        shard_id, pos = self.shard_set.locate(index)
+        _hdr, idx = self.shard_set.shard_table(shard_id)
+        ext_row = idx[pos]
+        label = int(ext_row[IDX_LABEL])
+        is_jpeg = bool(int(ext_row[IDX_FLAGS]) & FLAG_JPEG)
+
+        def read_bytes():
+            return engine.read_row(shard_id, pos)
+
+        key = ("dpts", self.location, int(index))
+        arr = native_decode_sample(
+            read_bytes, is_jpeg, self.transform, rng,
+            decode_cache=self.decode_cache, cache_key=("native",) + key,
+            out=out,
+        )
+        if arr is None:
+            arr = pil_decode_sample(
+                read_bytes, self.transform, rng,
+                decode_cache=self.decode_cache, cache_key=("pil",) + key,
+            )
+            holder["pil"] = True
+        return arr, label, holder
+
+    def get(self, index: int, rng: Optional[np.random.Generator] = None):
+        """Load + transform one sample; mirrors
+        :meth:`ImageFolderDataset.get` (same rng convention, same decode
+        paths, bit-identical pixels for the same source image)."""
+        if rng is None:
+            rng = np.random.default_rng(index)
+        arr, label, _ = self._decode(index, rng)
+        return arr, label
+
+    def get_into(self, index: int, rng, out: np.ndarray) -> int:
+        """Decode + transform DIRECTLY into ``out`` (one row of the
+        loader's preallocated batch); returns the label."""
+        from dptpu.data.dataset import _copy_checked
+
+        arr, label, holder = self._decode(index, rng, out=out)
+        if holder.get("pil") or arr is not out:
+            _copy_checked(out, arr, index)
+        return label
+
+    def __getitem__(self, index: int):
+        return self.get(index)
+
+    # -- loader hooks --------------------------------------------------------
+
+    def prefetch_extents(self, indices) -> None:
+        """Pre-issue hook (the fadvise slot's replacement): stage these
+        samples' extents into the slab ahead of the decode workers."""
+        if not self._closed:
+            self.engine().prefetch(indices)
+
+    def io_stats(self) -> dict:
+        if self._closed:
+            return {"shard_streaming": True}
+        stats = self.engine().io_stats()
+        stats["shard_fetch_mode"] = self._fetch_mode
+        return stats
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None
+        if self.byte_cache is not None:
+            self.byte_cache.close()
+        cache = self.decode_cache
+        if cache is not None and hasattr(cache, "close"):
+            cache.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -33,31 +33,18 @@ def step_checkpoint_name(epoch: int, step_in_epoch: int) -> str:
     return f"checkpoint-e{epoch:04d}-s{step_in_epoch:06d}.pth.tar"
 
 
-def verify_checkpoint(path: str) -> tuple:
-    """Cheap integrity triage without building a state template; returns
-    ``(ok, reason)``.
-
-    * empty file → rejected (crashed write);
-    * dptpu file with CRC footer → CRC decides;
-    * footerless flax file (pre-resilience) → accepted iff the msgpack
-      envelope still parses to a dict (catches truncation, which also
-      removes the footer a new-format file would have had);
-    * reference torch file (zip / legacy-pickle magic) → accepted
-      (no checksum to check; ``load_checkpoint`` handles the rest).
-    """
+def verify_checkpoint_bytes(raw: bytes, name: str = "<bytes>") -> tuple:
+    """The byte-level half of :func:`verify_checkpoint` — shared by the
+    local path and the store-URL path (a remote checkpoint is verified
+    from its fetched bytes with the IDENTICAL rules)."""
     from dptpu.train.checkpoint import CorruptCheckpointError, split_payload
 
-    try:
-        with open(path, "rb") as f:
-            raw = f.read()
-    except OSError as e:
-        return False, f"unreadable: {e}"
     if not raw:
         return False, "empty file (0 bytes)"
     if raw[:4] == b"PK\x03\x04" or raw[:2] == b"\x80\x02":
         return True, "torch-format (unverifiable, accepted)"
     try:
-        payload, verified = split_payload(raw, path)
+        payload, verified = split_payload(raw, name)
     except CorruptCheckpointError as e:
         return False, str(e)
     if verified:
@@ -73,35 +60,78 @@ def verify_checkpoint(path: str) -> tuple:
     return True, "legacy footerless (structurally intact, accepted)"
 
 
+def verify_checkpoint(path: str) -> tuple:
+    """Cheap integrity triage without building a state template; returns
+    ``(ok, reason)``. ``path`` may be a local file or a store URL.
+
+    * empty file → rejected (crashed write);
+    * dptpu file with CRC footer → CRC decides;
+    * footerless flax file (pre-resilience) → accepted iff the msgpack
+      envelope still parses to a dict (catches truncation, which also
+      removes the footer a new-format file would have had);
+    * reference torch file (zip / legacy-pickle magic) → accepted
+    (no checksum to check; ``load_checkpoint`` handles the rest).
+    """
+    from dptpu.data.store import is_store_url, open_store, split_store_url
+
+    if is_store_url(path):
+        base, name = split_store_url(path)
+        try:
+            raw = open_store(base).get_bytes(name)
+        except OSError as e:
+            return False, f"unreadable: {e}"
+        return verify_checkpoint_bytes(raw, path)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        return False, f"unreadable: {e}"
+    return verify_checkpoint_bytes(raw, path)
+
+
 def _candidates(directory: str):
-    """Checkpoint files in ``directory``, newest-first by mtime (the save
-    order). ``model_best`` is a copy, not a resume point — excluded."""
+    """Checkpoint files in ``directory`` (a local dir or a store URL),
+    newest-first by mtime (the save order). ``model_best`` is a copy,
+    not a resume point — excluded."""
+    from dptpu.data.store import open_store
+
+    store = open_store(directory)
     out = []
     try:
-        names = os.listdir(directory)
+        entries = store.list()
     except OSError:
         return out
-    for name in names:
+    for name, mtime in entries:
         if name == CHECKPOINT_NAME or STEP_CHECKPOINT_RE.match(name):
-            p = os.path.join(directory, name)
-            try:
-                out.append((os.path.getmtime(p), p))
-            except OSError:
-                continue
+            out.append((mtime, name))
     out.sort(reverse=True)
-    return [p for _, p in out]
+    return [store.path_for(name) for _, name in out]
 
 
 def find_resumable(path: str, verbose: bool = True) -> Optional[str]:
     """Resolve ``--resume PATH`` to the newest VERIFIABLE checkpoint.
 
     ``path`` may name a file (used if it verifies; otherwise its siblings
-    are scanned) or a directory (scanned directly). Returns None when
-    nothing loadable exists — the caller keeps the reference's
-    warn-and-continue behavior (imagenet_ddp.py:152-153).
+    are scanned) or a directory (scanned directly) — or the store-URL
+    equivalent of either (``.pth.tar`` URLs are files, any other URL is
+    scanned as a store prefix), with the IDENTICAL verify + fall-back-
+    past-corrupt contract. Returns None when nothing loadable exists —
+    the caller keeps the reference's warn-and-continue behavior
+    (imagenet_ddp.py:152-153).
     """
+    from dptpu.data.store import is_store_url, split_store_url
+
     tried = []
-    if os.path.isfile(path):
+    if is_store_url(path):
+        if path.endswith(".pth.tar"):
+            ok, reason = verify_checkpoint(path)
+            if ok:
+                return path
+            tried.append((path, reason))
+            directory = split_store_url(path)[0]
+        else:
+            directory = path.rstrip("/")
+    elif os.path.isfile(path):
         ok, reason = verify_checkpoint(path)
         if ok:
             return path
@@ -185,7 +215,10 @@ class CheckpointManager:
         # loop's data_wait/step/iter labels
         span_step = step_in_epoch - 1
         filename = step_checkpoint_name(epoch, step_in_epoch)
-        path = os.path.join(self.directory, filename)
+        from dptpu.data.store import is_store_url, open_store
+
+        path = open_store(self.directory).path_for(filename)
+        remote = is_store_url(path)
         run_async = self.async_writer is not None and not sync
         if run_async:
             import jax
@@ -224,10 +257,13 @@ class CheckpointManager:
                         if self.batch_size is not None else None
                     ),
                 )
-                if self.fault_plan is not None:
+                if self.fault_plan is not None and not remote:
                     # fault hooks (ckpt_truncate@save=N) count ACTUAL
                     # writes in write order, so they ride the writer
-                    # thread too
+                    # thread too. ckpt_truncate tears the LOCAL file in
+                    # place — a store URL has no file to tear, so the
+                    # hook stands down there (never silently miscounts:
+                    # the chaos benches always run against local dirs)
                     self.fault_plan.on_checkpoint_saved(path)
                 self._rotate()
 
@@ -258,20 +294,24 @@ class CheckpointManager:
         # corrupt-fallback resume an old torn higher-step file can still
         # sit in the directory, and position-ordering would keep it while
         # evicting the fresh valid saves — mtime matches find_resumable's
-        # newest-first scan, so rotation and resume agree on "newest"
+        # newest-first scan, so rotation and resume agree on "newest".
+        # Listing + deletion go through the Store, so rotation works
+        # identically against a --ckpt-dir store URL.
+        from dptpu.data.store import open_store
+
+        store = open_store(self.directory)
         files = []
-        for name in os.listdir(self.directory):
+        try:
+            entries = store.list()
+        except OSError:
+            return
+        for name, mtime in entries:
             m = STEP_CHECKPOINT_RE.match(name)
             if m:
-                path = os.path.join(self.directory, name)
-                try:
-                    mtime = os.path.getmtime(path)
-                except OSError:
-                    continue
                 files.append((mtime, int(m.group(1)), int(m.group(2)), name))
         files.sort()  # oldest save first
         for _, _, _, name in files[: max(len(files) - self.keep, 0)]:
             try:
-                os.remove(os.path.join(self.directory, name))
+                store.delete(name)
             except OSError:
                 pass

@@ -139,6 +139,7 @@ class FaultPlan:
         self._saves_done = 0
         self._kill_worker_cb: Optional[Callable] = None
         self._worker_rng: Optional[random.Random] = None
+        self._store_rng: Optional[random.Random] = None
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["FaultPlan"]:
@@ -183,6 +184,25 @@ class FaultPlan:
                     fh.truncate(max(size // 2, 1))
                 return True
         return False
+
+    # -- store-side hook ----------------------------------------------------
+
+    def on_store_io(self, desc: str):
+        """Call before every Store operation (dptpu/data/store.py): an
+        ``io_error:p=F`` fault raises an injected transient ``OSError``
+        with probability F — the range-fetch chaos path. A SEPARATE rng
+        stream from the decode hook's (seeded off the fault seed alone),
+        so store and decode injections don't perturb each other's draws;
+        a retried op draws fresh, making the fault transient."""
+        for f in self.faults:
+            if f.kind != "io_error":
+                continue
+            if self._store_rng is None:
+                self._store_rng = random.Random((self.seed << 16) ^ 0xB00C)
+            if self._store_rng.random() < f.p:
+                raise OSError(
+                    f"injected io_error (p={f.p}) on store op {desc!r}"
+                )
 
     # -- worker-side hook ---------------------------------------------------
 

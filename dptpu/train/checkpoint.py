@@ -24,9 +24,7 @@ LR schedule resumes on the reference's epoch boundary.
 
 from __future__ import annotations
 
-import os
 import queue
-import shutil
 import struct
 import threading
 import zlib
@@ -211,28 +209,21 @@ def save_checkpoint(
             data_position if data_position is not None else -1
         ),
     }
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, filename)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(seal_payload(serialization.to_bytes(payload)))
-        f.flush()
-        # atomic rename alone is not durable: without the fsync the
-        # kernel may rename before the data blocks land, and a power
-        # loss yields a zero-length (or half-written) "checkpoint"
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    try:  # best-effort: persist the rename itself (the dirent)
-        dirfd = os.open(directory or ".", os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
-    except OSError:
-        pass  # e.g. filesystems/platforms that refuse directory fds
+    # EVERY checkpoint write goes through the Store abstraction
+    # (dptpu/data/store.py): a plain directory routes to LocalStore —
+    # whose put_bytes is the exact tmp+flush+fsync+rename+dirent-fsync
+    # discipline this function used to inline, bit-for-bit — and a
+    # store URL (--ckpt-dir file:///... or http(s)://...) routes to the
+    # matching backend with retry/backoff. The CRC footer is sealed
+    # into the bytes BEFORE the store sees them, so the verify/fallback
+    # contract is backend-independent.
+    from dptpu.data.store import open_store
+
+    store = open_store(directory or ".")
+    store.put_bytes(filename, seal_payload(serialization.to_bytes(payload)))
     if is_best:
-        shutil.copyfile(path, os.path.join(directory, BEST_NAME))
-    return path
+        store.copy(filename, BEST_NAME)
+    return store.path_for(filename)
 
 
 def load_checkpoint(path: str, state, arch: Optional[str] = None,
@@ -250,8 +241,14 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     ``steps_per_epoch`` rebuilds the global step from the torch
     checkpoint's epoch, which stores no step count.
     """
-    with open(path, "rb") as f:
-        raw = f.read()
+    from dptpu.data.store import is_store_url, open_store, split_store_url
+
+    if is_store_url(path):
+        base, name = split_store_url(path)
+        raw = open_store(base).get_bytes(name)
+    else:
+        with open(path, "rb") as f:
+            raw = f.read()
     if not raw:
         raise EmptyCheckpointError(
             f"{path}: checkpoint file is empty (0 bytes) — a crashed or "
@@ -265,7 +262,8 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     # surfaces its own precise error instead of an unpickling one (and
     # the torch path never pays for building the flax template)
     if raw[:4] == b"PK\x03\x04" or raw[:2] == b"\x80\x02":
-        return _load_torch_checkpoint(path, state, arch, steps_per_epoch)
+        return _load_torch_checkpoint(path, state, arch, steps_per_epoch,
+                                      raw=raw)
     raw, _verified = split_payload(raw, path)
     template = {
         "epoch": 0,
@@ -330,11 +328,15 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
 
 
 def _load_torch_checkpoint(path: str, state, arch: Optional[str],
-                           steps_per_epoch: Optional[int]):
+                           steps_per_epoch: Optional[int],
+                           raw: Optional[bytes] = None):
     """Resume from the reference's own ``torch.save`` checkpoint
     (imagenet_ddp.py:216-222): ``module.``-prefixed state dict through
     the torchvision key map, SGD momentum buffers onto the optax trace.
-    """
+    ``raw`` carries already-fetched bytes (store-URL resumes have no
+    local file for torch to open)."""
+    import io
+
     import numpy as np
     import torch
 
@@ -344,7 +346,10 @@ def _load_torch_checkpoint(path: str, state, arch: Optional[str],
         torch_key_map,
     )
 
-    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    ckpt = torch.load(
+        io.BytesIO(raw) if raw is not None else path,
+        map_location="cpu", weights_only=False,
+    )
     arch = str(ckpt.get("arch") or arch or "")
     if not arch:
         raise ValueError(

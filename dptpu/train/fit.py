@@ -90,6 +90,24 @@ def _axis_env_knob(name: str, what: str) -> int:
     return n or 0
 
 
+def _shard_source(data: str):
+    """``(train_loc, val_loc)`` when ``data`` names a PACKED-shard tree
+    (``dptpu pack`` layout: train/ + val/ each holding a manifest) —
+    either a store URL (http(s)://, file://) or a local directory with
+    manifests — else None (plain ImageFolder)."""
+    import os
+
+    from dptpu.data.shards import MANIFEST_NAME
+    from dptpu.data.store import is_store_url
+
+    if is_store_url(data):
+        base = data.rstrip("/")
+        return f"{base}/train", f"{base}/val"
+    if os.path.exists(os.path.join(data, "train", MANIFEST_NAME)):
+        return os.path.join(data, "train"), os.path.join(data, "val")
+    return None
+
+
 def _build_datasets(cfg: Config, image_size: int, cache_bytes: int = 0,
                     cache_scope: str = "sharded"):
     import os
@@ -99,11 +117,29 @@ def _build_datasets(cfg: Config, image_size: int, cache_bytes: int = 0,
         train_ds = SyntheticDataset(n, image_size, 1000)
         val_ds = SyntheticDataset(max(n // 10, 1), image_size, 1000)
         return train_ds, val_ds, 1000
-    traindir = os.path.join(cfg.data, "train")
-    valdir = os.path.join(cfg.data, "val")
     # DPTPU_CACHE_BYTES is a PER-DATASET budget: train and val each keep
     # their own decoded-pixel cache (val redecodes the same files every
     # epoch, so it benefits at least as much per byte)
+    shards = _shard_source(cfg.data)
+    if shards is not None:
+        # packed-shard streaming data plane (dptpu/data/stream.py):
+        # pixels are bit-identical to the ImageFolder path by
+        # construction, so --data may point at either form of the same
+        # dataset and a seeded run cannot tell the difference
+        from dptpu.data import ShardStreamDataset
+
+        train_ds = ShardStreamDataset(
+            shards[0], train_transform(image_size),
+            cache_bytes=cache_bytes, cache_scope=cache_scope,
+        )
+        val_ds = ShardStreamDataset(
+            shards[1],
+            val_transform(image_size, resize=int(image_size * 256 / 224)),
+            cache_bytes=cache_bytes, cache_scope=cache_scope,
+        )
+        return train_ds, val_ds, len(train_ds.classes)
+    traindir = os.path.join(cfg.data, "train")
+    valdir = os.path.join(cfg.data, "val")
     train_ds = ImageFolderDataset(
         traindir, train_transform(image_size), cache_bytes=cache_bytes,
         cache_scope=cache_scope,
@@ -415,18 +451,47 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     )
 
     # per-host loaders over disjoint shards (DistributedSampler contract);
-    # batches are per-HOST (global batch = per_host × hosts)
+    # batches are per-HOST (global batch = per_host × hosts).
+    # DPTPU_SHARD_LOCALITY=1 (packed-shard data only; opt-in — it
+    # REORDERS the epoch visit, so the trajectory diverges from the
+    # ImageFolder-identical default) swaps the global permutation for
+    # the seeded shard-level shuffle + in-shard shuffle: sequential
+    # extent I/O, one shard resident at a time, still pure in
+    # (seed, epoch) so mid-epoch --resume replays exactly.
+    from dptpu.envknob import env_bool as _sl_bool
+
+    want_locality = _sl_bool("DPTPU_SHARD_LOCALITY", False)
+    use_locality = want_locality and hasattr(train_ds, "shard_set")
+    if want_locality and not use_locality and verbose:
+        print("=> DPTPU_SHARD_LOCALITY ignored: --data is not a "
+              "packed-shard tree (dptpu pack)")
+    if use_locality and verbose:
+        print("=> shard-locality sampling: seeded shard-level shuffle "
+              "+ in-shard shuffle (sequential extent I/O; trajectory "
+              "differs from the global-permutation default)")
     host_batch = derived.per_host_batch_size
-    train_loader = DataLoader(
-        train_ds,
-        host_batch,
-        sampler=ShardedSampler(
+    if use_locality:
+        from dptpu.data import ShardLocalitySampler
+
+        train_sampler = ShardLocalitySampler(
+            train_ds.shard_set,
+            num_shards=derived.num_processes,
+            shard_index=derived.process_index,
+            shuffle=True,
+            seed=cfg.seed if cfg.seed is not None else 0,
+        )
+    else:
+        train_sampler = ShardedSampler(
             len(train_ds),
             num_shards=derived.num_processes,
             shard_index=derived.process_index,
             shuffle=True,
             seed=cfg.seed if cfg.seed is not None else 0,
-        ),
+        )
+    train_loader = DataLoader(
+        train_ds,
+        host_batch,
+        sampler=train_sampler,
         # the sum of the reference's per-GPU worker pools: each of the
         # n_local device-slots gets ceil(workers / n_local) decode threads
         # (imagenet_ddp.py:126), pooled in this host's single loader
@@ -784,6 +849,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         )
         train_loader.close()
         val_loader.close()
+        for ds in (train_ds, val_ds):
+            if hasattr(ds, "close"):
+                ds.close()
         return {"val": stats, "state": state, "epochs_run": 0}
 
     # rank-0-only TensorBoard with the reference's run-config comment tag
@@ -803,6 +871,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             )
         )
         ckpt_dir = writer.log_dir  # apex checkpoints into the run dir (:271-277)
+    if cfg.ckpt_dir:
+        # explicit --ckpt-dir wins over both defaults; may be a plain
+        # directory OR a store URL (file:// / http(s)://) — every save,
+        # the rotation scan and --resume route through dptpu.data.store
+        # with the CRC-footer + fallback-scan contract unchanged
+        ckpt_dir = cfg.ckpt_dir
 
     # structured tracing (SURVEY.md §5: the reference has only wall-clock
     # meters; dptpu adds an opt-in XLA profile): DPTPU_PROFILE=<dir> traces
@@ -1073,8 +1147,13 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if fault_plan is not None and boundary_path:
                 # boundary saves count toward ckpt_truncate@save=N too —
                 # the fault targets "the N-th checkpoint written", not
-                # only the rotated step files
-                fault_plan.on_checkpoint_saved(boundary_path)
+                # only the rotated step files. Store-URL saves have no
+                # local file to tear, so the hook stands down there
+                # (the CheckpointManager applies the same guard)
+                from dptpu.data.store import is_store_url as _is_url
+
+                if not _is_url(boundary_path):
+                    fault_plan.on_checkpoint_saved(boundary_path)
             # one registry, one fan-out (dptpu/obs): the reference's 11
             # scalars/epoch (imagenet_ddp_apex.py:280-290), the feed
             # telemetry, and the step-phase attribution all publish into
@@ -1112,9 +1191,16 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 ("Feed/issue_ahead_depth", "issue_ahead_depth"),
                 ("Feed/straggler_reissues", "straggler_reissues"),
                 ("Feed/io_wait_s", "io_wait_s"),
+                # packed-shard streaming plane (dptpu/data/stream.py):
+                # byte-ring vs fadvise ownership, store fetch health
+                ("Feed/odirect_active", "odirect_active"),
+                ("Feed/shard_bytes_read", "shard_bytes_read"),
+                ("Feed/shard_extents_read", "shard_extents_read"),
+                ("Feed/store_wait_s", "store_wait_s"),
+                ("Feed/store_retries", "store_retries"),
             ):
                 if key in train_stats:
-                    scalars[tag] = train_stats[key]
+                    scalars[tag] = float(train_stats[key])
             # large-batch engine telemetry (Opt/*): accumulation depth,
             # the layer-wise trust-ratio spread (min/mean/max over
             # layers, from the optimizer's own norms), and — under the
@@ -1176,7 +1262,10 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     directory=ckpt_dir,
                 )
                 if fault_plan is not None and early_path:
-                    fault_plan.on_checkpoint_saved(early_path)
+                    from dptpu.data.store import is_store_url as _is_url
+
+                    if not _is_url(early_path):
+                        fault_plan.on_checkpoint_saved(early_path)
                 if verbose:
                     print(
                         f"top-1 accuracy {best_acc1:.3f} reached desired "
@@ -1264,6 +1353,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         )
     train_loader.close()
     val_loader.close()
+    for ds in (train_ds, val_ds):
+        # streaming datasets own fds + /dev/shm staging slabs; release
+        # them at the end of the run (ImageFolder/Synthetic have no
+        # close — their caches are reclaimed by the atexit sweeps)
+        if hasattr(ds, "close"):
+            ds.close()
     result.update({"state": state, "best_acc1": best_acc1,
                    "epochs_run": len(result["history"])})
     return result

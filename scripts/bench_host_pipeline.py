@@ -745,6 +745,9 @@ def main():
     if args.ring_sweep:
         ring_sweep(train_root, args, results, cores)
 
+    from bench_util import host_provenance
+
+    results["host"] = host_provenance()
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {args.out}")

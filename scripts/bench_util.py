@@ -1,0 +1,67 @@
+"""Shared bench-artifact helpers.
+
+``host_provenance()`` is stamped into EVERY committed bench artifact
+(``run_*bench.py`` all call it): ROADMAP's standing caveat — "every
+number since r6 is from a throttled 2-core host" — becomes a
+machine-readable field instead of prose, so a future reader (or a
+re-run on a real TPU box) can tell at a glance which hardware produced
+which number, and automated comparisons can refuse to diff artifacts
+from different host classes.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+
+def make_jpeg_imagefolder(root: str, n_images: int, n_classes: int = 2,
+                          px=(96, 80), low=(12, 10),
+                          quality: int = 85) -> None:
+    """Synthetic JPEG ImageFolder split (class dirs directly under
+    ``root``): low-res noise upscaled so files have realistic JPEG
+    structure; deterministic per class. Shared by run_databench and
+    run_faultbench — keep ``px`` under ``out_size * 8/7`` when an arm
+    needs the native scale picker pinned at 8/8 (the cache-arm
+    bit-exactness discipline; faultbench passes (52, 44) for 48 px)."""
+    import numpy as np
+    from PIL import Image
+
+    per = max(1, n_images // n_classes)
+    for c in range(n_classes):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        rng = np.random.RandomState(c)
+        for i in range(per):
+            noise = rng.randint(0, 255, (low[1], low[0], 3), np.uint8)
+            img = Image.fromarray(noise).resize(px, Image.BILINEAR)
+            img.save(os.path.join(d, f"{i}.jpg"), quality=quality)
+
+
+def host_provenance() -> dict:
+    """The host fingerprint every bench artifact carries: CPU budget,
+    platform triple, interpreter and jax/XLA versions. Cheap, pure,
+    and safe to call before OR after jax initializes a backend."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+        # backend platform only if already initialized elsewhere is
+        # irrelevant here: benches record their own platform field
+    except Exception:  # jax-less callers (pure host benches)
+        jax_version = None
+    affinity = None
+    if hasattr(os, "sched_getaffinity"):
+        try:
+            affinity = len(os.sched_getaffinity(0))
+        except OSError:
+            affinity = None
+    return {
+        "cpu_count": os.cpu_count(),
+        "cpu_affinity": affinity,
+        "platform": platform.platform(),
+        "machine": platform.machine(),
+        "python": sys.version.split()[0],
+        "jax": jax_version,
+    }

@@ -229,6 +229,9 @@ def main():
                 ref_pass = (bool(report.get("pass_top1_bar", True))
                             and bool(report.get("pass_bf16_delta", True)))
             report["pass"] = ref_pass and lb["pass"]
+        from bench_util import host_provenance
+
+        report["host"] = host_provenance()
         with open(out, "w") as f:
             json.dump(report, f, indent=1)
         print(json.dumps({k: lb[k] for k in (
@@ -273,6 +276,9 @@ def main():
     out = args.out if os.path.isabs(args.out) else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), args.out
     )
+    from bench_util import host_provenance
+
+    report["host"] = host_provenance()
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps({k: report[k] for k in (

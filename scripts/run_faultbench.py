@@ -25,6 +25,15 @@ synthetic data, each compared against one uninterrupted baseline run:
                       cache-off baseline bit for bit (the slab survives
                       the pool restart warm, and warm ≡ cold by the
                       hit≡miss contract).
+* ``shard_fetch_retry`` — the round-12 streaming data plane under
+                      chaos: the SAME JPEGs packed into CRC-sealed
+                      shards (``dptpu pack``) served over an HTTP range
+                      store, with ``io_error`` injected into EVERY
+                      store operation; the store's retry/backoff
+                      absorbs the faults and the run must match the
+                      local ImageFolder baseline bit for bit (the
+                      streaming bit-identity contract + fetch
+                      resilience, end to end).
 * ``worker_kill_ahead`` — the round-8 decode-ahead feed under chaos:
                       deep ring (DPTPU_RING_DEPTH=8), spans pre-issued
                       for DPTPU_DECODE_AHEAD=5 future batches,
@@ -52,6 +61,7 @@ import sys
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # CPU by default: the chaos contract (determinism under preemption) is
 # platform-independent; set JAX_PLATFORMS to chaos-run a real chip.
@@ -68,26 +78,22 @@ _ENV_KNOBS = ("DPTPU_FAULT", "DPTPU_FAULT_SEED", "DPTPU_WORKERS_MODE",
               "DPTPU_SPAN_RETRIES", "DPTPU_WORKER_TIMEOUT_S",
               "DPTPU_POOL_RESTARTS", "DPTPU_CACHE_BYTES",
               "DPTPU_CACHE_SCOPE", "DPTPU_LEASE", "DPTPU_RING_DEPTH",
-              "DPTPU_DECODE_AHEAD", "DPTPU_SPECULATE", "DPTPU_READAHEAD")
+              "DPTPU_DECODE_AHEAD", "DPTPU_SPECULATE", "DPTPU_READAHEAD",
+              "DPTPU_STORE_RETRIES", "DPTPU_STORE_BACKOFF_S",
+              "DPTPU_SHARD_CACHE_BYTES", "DPTPU_ODIRECT",
+              "DPTPU_STORE_FETCH")
 
 
-def make_jpeg_imagefolder(root, n_train, n_val, n_classes=2):
+def make_jpeg_tree(root, n_train, n_val, n_classes=2):
     """Tiny 52×44 JPEGs (< 48·8/7, so the native scale picker stays at
     8/8 and cache-on/off is bit-exact — the tests' fixture discipline)
-    in ImageFolder layout, for the pooled-slab chaos scenario."""
-    import numpy as np
-    from PIL import Image
+    in train/+val/ ImageFolder layout, for the jpeg chaos scenarios
+    (the per-split generator is the shared bench_util helper)."""
+    from bench_util import make_jpeg_imagefolder
 
-    rng = np.random.RandomState(0)
     for split, n in (("train", n_train), ("val", n_val)):
-        per = max(1, n // n_classes)
-        for c in range(n_classes):
-            d = os.path.join(root, split, f"class{c}")
-            os.makedirs(d, exist_ok=True)
-            for i in range(per):
-                low = rng.randint(0, 255, (8, 7, 3), np.uint8)
-                img = Image.fromarray(low).resize((52, 44), Image.BILINEAR)
-                img.save(os.path.join(d, f"{i}.jpg"), quality=85)
+        make_jpeg_imagefolder(os.path.join(root, split), n, n_classes,
+                              px=(52, 44), low=(8, 7))
 
 
 def run_fit(cfg, image_size, workdir, env=None):
@@ -248,7 +254,7 @@ def main():
     # decode slab + affinity routing + leased slots) chaos-tested on
     # real JPEGs — its own thread-mode cache-off baseline, same seed
     jpeg_root = os.path.join(root, "jpegs")
-    make_jpeg_imagefolder(jpeg_root, args.images, args.batch)
+    make_jpeg_tree(jpeg_root, args.images, args.batch)
     jcfg = cfg.replace(data=jpeg_root)
     jbase = run_fit(jcfg, 48, os.path.join(root, "jpeg_baseline"))
     d = os.path.join(root, "worker_kill_pooled")
@@ -271,7 +277,42 @@ def main():
         "max_abs_dloss": trajectory_delta(jbase["history"], r["history"]),
     })
 
-    # 6. worker_kill_ahead: the round-8 decode-ahead feed under chaos —
+    # 6. shard_fetch_retry: pack the SAME jpegs, serve them over an
+    # HTTP range store, inject io_error into every store op — the
+    # store's retry/backoff must absorb the chaos and the run must
+    # match the ImageFolder baseline bit for bit (thread mode isolates
+    # the STORE retry path: no decode-worker hook fires)
+    from dptpu.data import write_shards
+    from dptpu.data.store import dev_store_server
+
+    packed_root = os.path.join(root, "packed")
+    write_shards(os.path.join(jpeg_root, "train"),
+                 os.path.join(packed_root, "train"), 2)
+    write_shards(os.path.join(jpeg_root, "val"),
+                 os.path.join(packed_root, "val"), 2)
+    server, url = dev_store_server(packed_root)
+    try:
+        d = os.path.join(root, "shard_fetch_retry")
+        r = run_fit(jcfg.replace(data=url), 48, d,
+                    env={"DPTPU_FAULT": "io_error:p=0.1",
+                         "DPTPU_FAULT_SEED": "1",
+                         "DPTPU_STORE_RETRIES": "40",
+                         "DPTPU_STORE_BACKOFF_S": "0.002"})
+    finally:
+        server.shutdown()
+    last = r["history"][-1] if r["history"] else {}
+    scenarios.append({
+        "name": "shard_fetch_retry",
+        "fault": "io_error:p=0.1 (store ops, HTTP range store)",
+        "preempted": bool(r["preempted"]),
+        "recoveries": recoveries(r),
+        "store_retries": int(last.get("train_store_retries", 0)),
+        "store_wait_s": float(last.get("train_store_wait_s", 0.0)),
+        "params_max_delta": params_max_delta(jbase["state"], r["state"]),
+        "max_abs_dloss": trajectory_delta(jbase["history"], r["history"]),
+    })
+
+    # 7. worker_kill_ahead: the round-8 decode-ahead feed under chaos —
     # deep ring, spans for several future batches pre-issued, straggler
     # SPECULATION armed, and a worker SIGKILLed mid-run: the supervisor
     # must re-enqueue every pre-issued span and the run must stay
@@ -303,8 +344,11 @@ def main():
         s["bit_identical"] = (
             s["params_max_delta"] == 0.0 and s["max_abs_dloss"] == 0.0
         )
+    from bench_util import host_provenance
+
     out = {
         "bench": "faultbench",
+        "host": host_provenance(),
         "platform": jax.devices()[0].platform,
         "config": {
             "arch": args.arch, "image_size": args.image_size,

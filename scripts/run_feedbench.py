@@ -290,6 +290,9 @@ def main():
             for h in hist
         ],
     }
+    from bench_util import host_provenance
+
+    out["host"] = host_provenance()
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: out[k] for k in (

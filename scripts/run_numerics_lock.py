@@ -136,6 +136,9 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "NUMERICS.json",
     )
+    from bench_util import host_provenance
+
+    report["host"] = host_provenance()
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps({k: report[k] for k in (

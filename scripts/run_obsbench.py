@@ -190,6 +190,9 @@ def main():
         "gates": gates,
         "bench_wall_s": round(bench_s, 1),
     }
+    from bench_util import host_provenance
+
+    out["host"] = host_provenance()
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: out[k] for k in (

@@ -356,6 +356,9 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         args.out,
     )
+    from bench_util import host_provenance
+
+    report["host"] = host_provenance()
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps({

@@ -331,6 +331,9 @@ def main():
         "gates": gates,
         "bench_wall_s": round(time.time() - t_bench, 1),
     }
+    from bench_util import host_provenance
+
+    out["host"] = host_provenance()
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"saturation_qps": saturation_qps,

@@ -55,6 +55,12 @@ _FAST_MODULES = {
     # tier 1; the servebench smoke is the third fit-shaped exception
     # (one subprocess, --smoke preset, same gates as SERVEBENCH.json)
     "test_serve", "test_serve_knobs", "test_servebench_smoke",
+    # streaming data plane (PR 8): store/shard units are pure-fast;
+    # test_shards holds the bit-identity + resume-on-shards acceptance
+    # bars (ONE resnet18@48 compile, the test_fault_resume precedent);
+    # the databench smoke is the fourth fit-shaped exception (one
+    # subprocess, --smoke preset, same gates as DATABENCH.json)
+    "test_shards", "test_store", "test_databench_smoke",
 }
 
 
@@ -87,6 +93,7 @@ def dptpu_shm_leak_guard():
     import glob
 
     from dptpu.data import shm as _shm
+    from dptpu.data import stream as _stream
     from dptpu.serve import staging as _serve_staging
 
     def lease_leaks():
@@ -94,6 +101,10 @@ def dptpu_shm_leak_guard():
                 + _serve_staging.leaked_lease_count())
 
     leases_before = lease_leaks()
+    # shard-file descriptors (the O_DIRECT/pread byte ring,
+    # dptpu/data/stream.py): every reader a test opens must be closed
+    # (dataset.close() or GC) by session end, or the suite fails
+    fds_before = _stream.open_fd_count()
     if not os.path.isdir("/dev/shm"):
         yield  # platform without a tmpfs view; segments can't be policed
         import gc
@@ -104,6 +115,9 @@ def dptpu_shm_leak_guard():
             "(consumer never released, no reset revoked) — a zero-copy "
             "lease leak"
         )
+        assert _stream.open_fd_count() <= fds_before, (
+            "shard-file descriptors leaked past dataset close()"
+        )
         return
     # segment names embed their CREATOR pid (dptpu_{kind}_{pid}_{hex});
     # only this process creates segments for this suite (workers merely
@@ -111,7 +125,8 @@ def dptpu_shm_leak_guard():
     # same host from tripping the guard
     mine = (f"/dev/shm/dptpu_ring_{os.getpid()}_*",
             f"/dev/shm/dptpu_cache_{os.getpid()}_*",
-            f"/dev/shm/dptpu_serve_{os.getpid()}_*")
+            f"/dev/shm/dptpu_serve_{os.getpid()}_*",
+            f"/dev/shm/dptpu_shard_{os.getpid()}_*")
     snapshot = lambda: {p for pat in mine for p in glob.glob(pat)}  # noqa: E731
     before = snapshot()
     yield
@@ -136,6 +151,10 @@ def dptpu_shm_leak_guard():
         "slots were still leased when their pipeline/ring closed "
         "(consumer never released, no reset revoked) — a zero-copy "
         "lease leak"
+    )
+    assert _stream.open_fd_count() <= fds_before, (
+        "shard-file descriptors leaked: a ShardFileReader opened during "
+        "the suite was never closed (dataset.close() missing?)"
     )
 
 

@@ -1,0 +1,48 @@
+"""Tier-1-adjacent smoke of scripts/run_databench.py: the streaming
+data plane's bit-identity gate (and the never-silently-skipped O_DIRECT
+arm) are continuously checked, not just on the bench host. One
+subprocess, smallest preset, same gate logic (the obsbench pattern)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_databench_smoke_gates(tmp_path):
+    out = str(tmp_path / "DATABENCH.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_databench.py"),
+         "--smoke", "--out", out],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"databench gate failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+    with open(out) as f:
+        bench = json.load(f)
+    # THE gate: streaming vs ImageFolder, max byte delta == 0
+    assert bench["gates"]["bit_identity_ok"]
+    assert bench["gates"]["bit_identity_max_delta"] == 0
+    arms = bench["arms"]
+    # every arm ran and produced a throughput number
+    for arm in ("imagefolder", "shards_read", "shards_odirect",
+                "shards_staged", "bounded_ram"):
+        assert arms[arm]["img_per_s"] > 0, arm
+    # the O_DIRECT arm is never silently skipped: either it ran with
+    # O_DIRECT active, or the fallback ran AND recorded the limitation
+    od = arms["shards_odirect"]
+    assert od["odirect_active"] or od.get("limitation"), od
+    # the remote curve covered the injected latencies
+    assert len(arms["remote_latency"]) >= 2
+    for point in arms["remote_latency"]:
+        assert point["img_per_s"] > 0
+    # host provenance is stamped (the machine-readable 2-core caveat)
+    host = bench["host"]
+    assert host["cpu_count"] and host["platform"] and host["jax"]
