@@ -79,6 +79,13 @@ class Config:
     accum_steps: int = 1
     warmup_epochs: int = 0
     label_smoothing: float = 0.0
+    # hierarchical data parallelism (dptpu extension, all variants):
+    # factor the data axis into {slice: S, dp_in_slice} so gradient
+    # reduction runs reduce-scatter on ICI and only a shard-sized
+    # all-reduce on DCN (dptpu/parallel/hierarchy.py). 1 = flat mesh,
+    # the reference topology. Env twin DPTPU_SLICES wins when set;
+    # DPTPU_DCN_DTYPE=bf16 additionally compresses the DCN hop.
+    slices: int = 1
     # distributed (ddp/nd; apex uses env:// exclusively)
     world_size: int = -1
     rank: int = -1
@@ -192,6 +199,18 @@ def build_parser(variant: str = "ddp", model_names=None) -> argparse.ArgumentPar
                    metavar="S",
                    help="label-smoothing mass in [0, 1) for the training "
                         "loss (0 = reference hard-target CE)")
+    # dptpu hierarchical-comms extension (not a reference flag): on a
+    # multi-slice pod the DCN hop between slices is ~10x slower than
+    # ICI; --slices S rewrites the gradient all-reduce as
+    # reduce-scatter(ICI) -> shard-sized all-reduce(DCN) ->
+    # all-gather(ICI), cutting per-chip DCN bytes to ~1/(N/S). Env twin:
+    # DPTPU_SLICES (wins when set); DPTPU_DCN_DTYPE=bf16 halves the DCN
+    # bytes again (fp32 accumulation).
+    p.add_argument("--slices", default=1, type=int, metavar="S",
+                   help="factor the data-parallel mesh into S "
+                        "DCN-connected slices for two-level gradient "
+                        "reduction (1 = flat mesh; S must divide the "
+                        "device count)")
     p.add_argument("-e", "--evaluate", dest="evaluate", action="store_true",
                    help="evaluate model on validation set")
     p.add_argument("--pretrained", dest="pretrained", action="store_true")

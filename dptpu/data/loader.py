@@ -536,6 +536,15 @@ class DataLoader:
         if self.workers_mode == "process":
             stats["leased"] = self.leased
             stats["span_affinity"] = self.span_affinity
+            # which affinity key routes spans to workers (the shared
+            # shm.routing_of derivation, so the lazy-pipeline fallback
+            # can never diverge from what the pipeline actually does)
+            from dptpu.data.shm import routing_of
+
+            stats["span_routing"] = (
+                self._pipeline.routing if self._pipeline is not None
+                else routing_of(self.dataset, self.span_affinity)
+            )
             copied = dict(self._copy_totals)
             ring = dict(self._ring_totals)
             if self._pipeline is not None:

@@ -163,11 +163,33 @@ def _affinity_of(index: int, num_workers: int) -> int:
     return ((index * 2654435761) >> 7) % num_workers
 
 
-def _affinity_spans(batch_indices, num_workers: int):
-    """Split one batch into per-worker spans by index affinity, then
+def routing_of(dataset, span_affinity: bool) -> str:
+    """Which affinity key routes spans to workers: ``"shard"`` (a
+    packed-shard dataset exposing ``shard_of`` — whole-shard-per-worker
+    routing on a stable hash of the shard id), ``"index"`` (per-sample
+    hash) or ``"contiguous"`` (affinity off). The ONE derivation —
+    ``ShmBatchPipeline`` routes by it and ``DataLoader.feed_stats``
+    reports it (also before the lazy pipeline exists), so the reported
+    mode can never diverge from the mode actually used."""
+    if not span_affinity:
+        return "contiguous"
+    return "shard" if getattr(dataset, "shard_of", None) is not None \
+        else "index"
+
+
+def _affinity_spans(batch_indices, num_workers: int, affinity_key=None):
+    """Split one batch into per-worker spans by affinity, then
     rebalance any group above ``ceil(B/N)`` down to the least-loaded
     workers (the idle-worker fallback: utilization beats affinity for
-    the overflow items). Returns ``[(wid, offsets, indices), ...]``."""
+    the overflow items). Returns ``[(wid, offsets, indices), ...]``.
+
+    ``affinity_key`` maps a sample index to the value that is hashed
+    (default: the index itself). Packed-shard datasets pass their
+    ``shard_of`` so a WHOLE shard's extents land on one worker — the
+    shard-level decode-cache affinity (ROADMAP data-plane follow-on):
+    the hash is stable in the SHARD id, so a shard's samples stay
+    together no matter how the sampler interleaves shards, instead of
+    scattering one shard's extent stream across every worker."""
     n = len(batch_indices)
     if num_workers <= 1:
         return [(0, tuple(range(n)),
@@ -175,7 +197,8 @@ def _affinity_spans(batch_indices, num_workers: int):
     groups = [([], []) for _ in range(num_workers)]
     for o, raw in enumerate(batch_indices):
         idx = int(raw)
-        g = groups[_affinity_of(idx, num_workers)]
+        key = idx if affinity_key is None else affinity_key(idx)
+        g = groups[_affinity_of(int(key), num_workers)]
         g[0].append(o)
         g[1].append(idx)
     cap = -(-n // num_workers)
@@ -340,6 +363,13 @@ class ShmBatchPipeline:
         self.num_workers = max(1, num_workers)
         self.slots = max(2, slots)
         self.span_affinity = span_affinity
+        # shard-level cache affinity: a packed-shard dataset exposes
+        # shard_of, and hashing THAT (not the sample index) routes a
+        # whole shard's extents to one worker (see _affinity_spans)
+        self.routing = routing_of(dataset, span_affinity)
+        self._affinity_key = (
+            dataset.shard_of if self.routing == "shard" else None
+        )
         self._dataset = dataset
         self._seed = seed
         self._has_cache = getattr(dataset, "decode_cache", None) is not None
@@ -494,7 +524,8 @@ class ShmBatchPipeline:
         # issues have fully drained)
         self._speculated = {k for k in self._speculated if k[0] != slot}
         spans = (
-            _affinity_spans(batch_indices, self.num_workers)
+            _affinity_spans(batch_indices, self.num_workers,
+                            self._affinity_key)
             if self.span_affinity
             else _contiguous_spans(batch_indices, self.num_workers)
         )

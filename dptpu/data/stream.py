@@ -609,6 +609,15 @@ class ShardStreamDataset:
     def __len__(self) -> int:
         return self.shard_set.num_samples
 
+    def shard_of(self, index: int) -> int:
+        """The packed shard holding global sample ``index`` — the
+        shm pipeline's shard-level cache-affinity key (dptpu/data/
+        shm.py): routing a whole shard's extents to one worker by a
+        stable hash of THIS id (not the sample index) keeps that
+        shard's decoded pixels hot in the worker's reach and its byte
+        extents coalesced in one engine stream."""
+        return self.shard_set.locate(index)[0]
+
     def __getstate__(self):
         # spawn boundary: workers rebuild their own engine (fds, HTTP
         # connections and threads never cross); per-shard index tables

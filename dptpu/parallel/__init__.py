@@ -7,13 +7,24 @@ collectives over ICI/DCN.
 """
 
 from dptpu.parallel.dist import initialize_distributed
+from dptpu.parallel.hierarchy import (
+    dcn_reduce_shard,
+    hierarchy_knobs,
+    is_hierarchical,
+    make_hierarchical_reduce,
+)
 from dptpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    SLICE_AXIS,
+    data_axis_names,
+    data_parallel_width,
     data_sharding,
+    make_hierarchical_mesh,
     make_mesh,
     replicated_sharding,
     shard_host_batch,
+    squeeze_axes,
 )
 from dptpu.parallel.gspmd import (
     make_gspmd_train_step,
@@ -34,10 +45,18 @@ from dptpu.parallel.zero import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "SLICE_AXIS",
+    "data_axis_names",
+    "data_parallel_width",
     "data_sharding",
+    "dcn_reduce_shard",
     "gather_state",
+    "hierarchy_knobs",
     "initialize_distributed",
+    "is_hierarchical",
     "make_gspmd_train_step",
+    "make_hierarchical_mesh",
+    "make_hierarchical_reduce",
     "make_mesh",
     "make_zero1_train_step",
     "replicated_sharding",
@@ -45,6 +64,7 @@ __all__ = [
     "swin_tp_specs",
     "shard_host_batch",
     "shard_zero1_state",
+    "squeeze_axes",
     "vit_tp_specs",
     "zero1_sharded_fraction",
     "zero1_state_specs",

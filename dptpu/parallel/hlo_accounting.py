@@ -1,0 +1,195 @@
+"""Per-chip collective-byte accounting parsed from HLO text.
+
+The single source of truth for every collective-traffic claim in the
+repo's benches and regression locks: SCALEBENCH's flat DDP/ZeRO-1
+accounting (scripts/run_scalebench.py), COMMBENCH's hierarchical
+per-link split (scripts/run_commbench.py), and the HLO-level tests in
+tests/test_hierarchy.py all call these parsers — a second copy of the
+byte math would let a bench and its regression lock silently diverge.
+
+Two views:
+
+* :func:`collective_bytes_per_chip` — the original SCALEBENCH r06
+  accounting, preserved verbatim: per-op-kind bytes one chip SENDS on a
+  ring, with the ring width taken as the GLOBAL device count ``n``.
+* :func:`collective_bytes_by_link` — the hierarchical view: every
+  instruction's ``replica_groups`` decide whether it runs inside one
+  slice (ICI) or crosses slices (DCN), and the ring width is the
+  GROUP size (identical to ``n`` for flat programs, where one group
+  spans the world — so the two views agree on every r06 program).
+
+Ring-send formulas (result shapes, as HLO writes them): all-gather's
+result is the full gathered array — a chip sends ``(m-1)/m`` of it;
+reduce-scatter's result is the scattered ``1/m`` slice — a chip sends
+``(m-1)×`` the result; all-reduce's result equals its input — ``2·
+(m-1)/m`` for the fused reduce-scatter + all-gather phases.
+
+Works on OPTIMIZED HLO (``lowered.compile().as_text()`` — the compiled
+program's own accounting, the default for every gate) and on
+PRE-OPTIMIZATION HLO (``lowered.compiler_ir(dialect="hlo")
+.as_hlo_text()``) — which COMMBENCH's bf16-DCN arm needs because this
+container's CPU backend has no bf16 collective kernels: its float
+normalization pass promotes every bf16 collective to f32 before the
+optimized text exists, so the requested wire dtype is only observable
+pre-optimization (on TPU the bf16 collective survives to the wire).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+_ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+             "pred": 1, "u8": 1, "s8": 1, "f64": 8, "u64": 8, "s64": 8}
+
+_OP_RE = re.compile(
+    r"=\s+(.*?)\s+"
+    r"(all-gather|reduce-scatter|all-reduce)(-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=(\[[\d,]+\])(T\(([\d,]+)\))?"
+)
+
+
+def _parse_groups(line: str) -> Optional[List[List[int]]]:
+    """Replica groups from one HLO instruction line, handling both the
+    explicit ``{{0,1},{2,3}}`` form and the iota-tile form
+    ``[G,M]<=[dims...](T(perm))?``. None when the attribute is absent
+    (a groupless collective spans every participant)."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([^}]*)\}", m.group(1)):
+            ids = [int(x) for x in grp.split(",") if x.strip() != ""]
+            groups.append(ids)
+        return groups
+    m = _IOTA_RE.search(line)
+    if m:
+        g, per, dims_s, _t, perm_s = m.groups()
+        import numpy as np
+
+        dims = [int(d) for d in dims_s.strip("[]").split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if perm_s:
+            arr = arr.transpose([int(p) for p in perm_s.split(",")])
+        return arr.reshape(int(g), int(per)).tolist()
+    return None
+
+
+def parse_collectives(hlo_text: str) -> List[Dict]:
+    """Every gather/scatter/reduce collective instruction in ``hlo_text``
+    as ``{"op", "result_bytes", "groups", "dtypes"}``.
+
+    Result shapes may be nested tuples (combined async collectives:
+    ``((f32[a], f32[b]), (f32[c], f32[d])) all-gather-start(...)``), so
+    every ``dtype[dims]`` token left of the op name is collected;
+    ``-done`` carries the same payload its ``-start`` already counted
+    and is skipped; async ``-start`` results are (operands..., results...)
+    pairs — only the result half is payload.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        result_part, op, suffix = m.groups()
+        if suffix == "-done":
+            continue
+        shapes = []
+        dtypes = []
+        for dt, dims in _SHAPE_RE.findall(result_part):
+            size = 1
+            for d in dims.split(","):
+                if d:
+                    size *= int(d)
+            shapes.append(size * _ITEMSIZE.get(dt, 4))
+            dtypes.append(dt)
+        if suffix == "-start" and len(shapes) > 1:
+            shapes = shapes[len(shapes) // 2:]
+            dtypes = dtypes[len(dtypes) // 2:]
+        out.append({
+            "op": op,
+            "result_bytes": sum(shapes),
+            "groups": _parse_groups(line),
+            "dtypes": dtypes,
+        })
+    return out
+
+
+def _send_bytes(op: str, result_bytes: int, m: int) -> int:
+    """Bytes ONE chip sends for one instruction on an m-wide ring."""
+    if m <= 1:
+        return 0
+    if op == "all-gather":
+        return int(result_bytes * (m - 1) / m)
+    if op == "reduce-scatter":
+        return int(result_bytes * (m - 1))
+    return int(result_bytes * 2 * (m - 1) / m)  # all-reduce
+
+
+def collective_bytes_per_chip(hlo_text: str, n: int) -> dict:
+    """The SCALEBENCH r06 accounting: per-op-kind per-chip ring-send
+    bytes with the ring width fixed at the global device count ``n``
+    (every r06 program's collectives span the whole world, so this
+    equals the group-aware view there — locked by
+    tests/test_hierarchy.py against the analytic formulas)."""
+    out = {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0,
+           "instructions": 0}
+    for inst in parse_collectives(hlo_text):
+        out["instructions"] += 1
+        out[inst["op"]] += _send_bytes(inst["op"], inst["result_bytes"], n)
+    out["total"] = (out["all-gather"] + out["reduce-scatter"]
+                    + out["all-reduce"])
+    return out
+
+
+def collective_bytes_by_link(
+    hlo_text: str, slice_of: Callable[[int], int], world: int
+) -> dict:
+    """Per-chip send bytes split by LINK CLASS on a two-level mesh.
+
+    ``slice_of`` maps a logical device id (the mesh-flattened position
+    the HLO's replica groups reference) to its slice; ``world`` is the
+    total participant count (the ring width for groupless collectives).
+    An instruction whose every group stays inside one slice is ICI; any
+    group spanning two slices makes the whole instruction DCN-crossing
+    — for the flat baseline that is the honest statement of what a
+    topology-blind all-reduce risks (its ring crosses DCN at full
+    gradient width). Ring width per instruction = its group size.
+
+    Returns per-kind dicts plus ``ici``/``dcn`` totals and instruction
+    counts, e.g. ``{"dcn": {"all-reduce": B, ...,"total": B,
+    "instructions": k}, "ici": {...}, "total": ...}``.
+    """
+    links = {
+        "ici": {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0,
+                "instructions": 0},
+        "dcn": {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0,
+                "instructions": 0},
+    }
+    for inst in parse_collectives(hlo_text):
+        groups = inst["groups"]
+        if not groups:
+            groups = [list(range(world))]
+        m = max(len(g) for g in groups)
+        crosses = any(
+            len({slice_of(d) for d in g}) > 1 for g in groups
+        )
+        link = links["dcn" if crosses else "ici"]
+        link["instructions"] += 1
+        link[inst["op"]] += _send_bytes(inst["op"], inst["result_bytes"], m)
+    for link in links.values():
+        link["total"] = (link["all-gather"] + link["reduce-scatter"]
+                         + link["all-reduce"])
+    links["total"] = links["ici"]["total"] + links["dcn"]["total"]
+    return links
+
+
+def preopt_hlo_text(lowered) -> str:
+    """Pre-optimization HLO text from a ``jax.jit(...).lower(...)``
+    result — where a requested bf16 wire dtype is still visible on
+    backends whose float normalization promotes bf16 collectives (this
+    container's CPU; see module docstring)."""
+    return lowered.compiler_ir(dialect="hlo").as_hlo_text()

@@ -24,6 +24,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+# Two-level data parallelism (dptpu/parallel/hierarchy.py): the OUTER
+# axis of a {slice, data} mesh. Chips inside one slice talk over ICI;
+# the slice axis is the DCN hop between slices, so a collective whose
+# replica groups span the slice axis is the expensive one.
+SLICE_AXIS = "slice"
+
+
+def largest_divisible_dim(shape, n: int) -> int:
+    """Largest dim of ``shape`` divisible by ``n`` (lowest index on
+    ties), -1 when none divides. The ONE shard-dim selection rule:
+    ZeRO-1's state layout (``zero._leaf_spec``) and the hierarchical
+    reduce-scatter (``hierarchy._scatter_dim``) both resolve through
+    here, which is what makes "the reduce-scatter output IS the 1/N
+    update shard" hold by construction — two copies of this loop could
+    silently desynchronize the gradient shard from the state shard."""
+    best = -1
+    for d, extent in enumerate(shape):
+        if extent >= n and extent % n == 0 and (
+            best < 0 or extent > shape[best]
+        ):
+            best = d
+    return best
+
+
+def _host_major_order(devices: Sequence[jax.Device]) -> list:
+    """Order devices host-major (every host's chips contiguous,
+    hosts by process index, chips by id) — the (DCN, ICI) factored
+    layout both mesh builders depend on. Raises on unequal
+    chips-per-host."""
+    per_host: dict = {}
+    for d in devices:
+        per_host.setdefault(getattr(d, "process_index", 0), []).append(d)
+    counts = {len(v) for v in per_host.values()}
+    if len(counts) != 1:
+        raise ValueError(
+            f"hierarchical mesh needs equal chips per host, got "
+            f"{ {k: len(v) for k, v in per_host.items()} }"
+        )
+    return [
+        d
+        for proc in sorted(per_host)
+        for d in sorted(per_host[proc], key=lambda d: getattr(d, "id", 0))
+    ]
 
 
 def make_mesh(
@@ -56,20 +99,7 @@ def make_mesh(
     if hierarchical is None:
         hierarchical = n_procs > 1
     if hierarchical:
-        per_host: dict = {}
-        for d in devices:
-            per_host.setdefault(getattr(d, "process_index", 0), []).append(d)
-        counts = {len(v) for v in per_host.values()}
-        if len(counts) != 1:
-            raise ValueError(
-                f"hierarchical mesh needs equal chips per host, got "
-                f"{ {k: len(v) for k, v in per_host.items()} }"
-            )
-        devices = [
-            d
-            for proc in sorted(per_host)
-            for d in sorted(per_host[proc], key=lambda d: getattr(d, "id", 0))
-        ]
+        devices = _host_major_order(devices)
     devices = np.asarray(devices)
     if mesh_shape is None:
         mesh_shape = {DATA_AXIS: -1}
@@ -90,9 +120,84 @@ def make_mesh(
     return Mesh(devices.reshape(sizes), names)
 
 
+def make_hierarchical_mesh(
+    slices: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the two-level ``{slice: S, data: N/S}`` data-parallel mesh
+    (``--slices`` / ``DPTPU_SLICES``; dptpu/parallel/hierarchy.py).
+
+    The slice axis is OUTER and host-major: slice ``s`` owns the
+    contiguous host-major device block ``[s·N/S, (s+1)·N/S)``, so the
+    inner ``data`` axis stays on intra-slice ICI links and only
+    slice-axis collectives cross DCN. On a multi-host pod every slice
+    must hold a whole number of hosts — a slice boundary through the
+    middle of a host would put "ICI" neighbours on different DCN
+    endpoints and void the two-level cost model.
+    """
+    if slices < 1:
+        raise ValueError(f"slices={slices} must be >= 1")
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if n % slices != 0:
+        raise ValueError(
+            f"DPTPU_SLICES/--slices {slices} does not divide the "
+            f"{n}-device world — pick a divisor so every slice gets "
+            f"the same number of chips"
+        )
+    n_procs = len({getattr(d, "process_index", 0) for d in devices})
+    if n_procs > 1:
+        if n_procs % slices != 0:
+            raise ValueError(
+                f"DPTPU_SLICES/--slices {slices} does not divide the "
+                f"{n_procs} hosts — a slice must hold whole hosts, or "
+                f"its 'intra-slice' axis would cross DCN"
+            )
+        # host-major ordering (the make_mesh(hierarchical=True) layout),
+        # then the contiguous S-way split puts each host fully inside
+        # one slice
+        devices = _host_major_order(devices)
+    return Mesh(
+        np.asarray(devices).reshape(slices, n // slices),
+        (SLICE_AXIS, DATA_AXIS),
+    )
+
+
+def data_axis_names(mesh: Optional[Mesh]) -> tuple:
+    """The mesh axes a data batch (and the gradient reduction) spans:
+    ``(slice, data)`` on a hierarchical mesh, ``(data,)`` otherwise."""
+    if mesh is not None and SLICE_AXIS in mesh.axis_names:
+        return (SLICE_AXIS, DATA_AXIS)
+    return (DATA_AXIS,)
+
+
+def squeeze_axes(names: tuple):
+    """Collapse a 1-tuple of axis names to the bare name. The one-name
+    spelling is LOAD-BEARING on single-axis meshes: it keeps their
+    compiled collectives byte-identical to the pre-hierarchy (r06)
+    programs — every call site that feeds axis names to a collective or
+    a PartitionSpec must route through this one helper rather than
+    hand-rolling the conditional."""
+    return names[0] if len(names) == 1 else names
+
+
+def data_parallel_width(mesh: Optional[Mesh]) -> int:
+    """Total data-parallel replicas: the product of the data axes'
+    sizes (``slices × dp_in_slice`` on a hierarchical mesh)."""
+    if mesh is None:
+        return 1
+    w = 1
+    for name in data_axis_names(mesh):
+        w *= int(mesh.shape[name])
+    return w
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for a batch: leading axis split over the data axis."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Sharding for a batch: leading axis split over the data axis (or
+    jointly over ``(slice, data)`` on a hierarchical mesh — slice-major,
+    so replica ``r``'s rows sit on the same chip either way)."""
+    return NamedSharding(mesh, P(squeeze_axes(data_axis_names(mesh))))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -56,7 +56,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dptpu.parallel.mesh import DATA_AXIS
+from dptpu.parallel.mesh import (
+    DATA_AXIS,
+    SLICE_AXIS,
+    data_axis_names,
+    data_parallel_width,
+    largest_divisible_dim,
+    squeeze_axes,
+)
 
 # NOTE: dptpu.train is imported lazily inside make_zero1_train_step —
 # a module-level import would close the cycle parallel/__init__ -> zero
@@ -71,14 +78,11 @@ def _leaf_spec(leaf, n: int) -> P:
     (lowest index on ties) keeps per-device shards from degenerating to
     width-1 slices on mixed-shape leaves. Leaves with no divisible dim
     (tiny biases, scalars) stay replicated — they are a rounding error
-    of the total (see ``zero1_sharded_fraction``)."""
-    shape = getattr(leaf, "shape", ())
-    best = -1
-    for d, extent in enumerate(shape):
-        if extent >= n and extent % n == 0 and (
-            best < 0 or extent > shape[best]
-        ):
-            best = d
+    of the total (see ``zero1_sharded_fraction``). The dim-selection
+    rule is the SHARED ``mesh.largest_divisible_dim`` — the
+    hierarchical reduce-scatter resolves through the same function, so
+    its gradient shard is the update shard by construction."""
+    best = largest_divisible_dim(getattr(leaf, "shape", ()), n)
     if best < 0:
         return P()
     return P(*([None] * best), DATA_AXIS)
@@ -213,7 +217,7 @@ def zero1_update_shard_bytes(state, mesh: Mesh) -> int:
 def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
                           lr_schedule=None, seed: int = 0,
                           accum_steps: int = 1, label_smoothing: float = 0.0,
-                          tx_factory=None):
+                          tx_factory=None, dcn_dtype: str = "fp32"):
     """ZeRO-1 / sharded-weight-update variant of
     ``dptpu.train.step.make_train_step``.
 
@@ -236,16 +240,38 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
     fp32 accumulator is SHARD-sized (1/N of the model — accumulation
     costs no replicated-gradient memory); params are re-gathered per
     microbatch, the price of never materializing full optimizer state.
+
+    On a hierarchical ``{slice, data}`` mesh the composition is exactly
+    the two-level engine's design (dptpu/parallel/hierarchy.py): state
+    shards over the INTRA-slice axis (so the per-microbatch weight
+    all-gather and its psum_scatter VJP stay on ICI — the all-gather
+    moves weights, never gradients), and ``reduce_grads`` adds only the
+    shard-sized cross-slice hop over DCN — ONCE per update, after the
+    accumulation scan, optionally bf16-compressed (``dcn_dtype``).
     """
+    from dptpu.parallel.hierarchy import (
+        DCN_DTYPES,
+        dcn_reduce_shard,
+        is_hierarchical,
+    )
     from dptpu.train.step import (
         shard_map_nocheck,
         tpu_compiler_options,
         train_step_body,
     )
 
+    if dcn_dtype not in DCN_DTYPES:
+        raise ValueError(
+            f"dcn_dtype={dcn_dtype!r} must be one of "
+            + "/".join(repr(d) for d in DCN_DTYPES)
+        )
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
-    axis_size = int(mesh.shape[DATA_AXIS])
+    hier = is_hierarchical(mesh)
+    axis_names = data_axis_names(mesh)
+    # gradient normalizer spans ALL replicas (slices × dp_in_slice);
+    # the state specs below shard over the intra-slice axis only
+    axis_size = data_parallel_width(mesh)
     specs = zero1_state_specs(state_template, mesh)
     tx = None
     if tx_factory is not None:
@@ -281,14 +307,22 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
         return jax.tree_util.tree_map(gather, params, specs.params)
 
     def reduce_grads(grads):
-        # the all-gather VJP already reduced the sharded leaves; the
-        # replicated remainder (no divisible dim) needs its explicit
-        # cross-replica mean — under check_rep=False nothing is implicit
-        return jax.tree_util.tree_map(
-            lambda g, s: g if _sharded_axis(s) >= 0
-            else lax.psum(g, DATA_AXIS),
-            grads, specs.params,
-        )
+        # the all-gather VJP already reduce-scattered the sharded leaves
+        # over the INTRA-slice axis; on a hierarchical mesh each shard
+        # then takes the shard-sized cross-slice (DCN) hop — this is the
+        # "reduce-scatter output IS the 1/N update shard" composition,
+        # and it runs once per UPDATE (reduce_grads sits after the
+        # accumulation scan), never per microbatch. The replicated
+        # remainder (no divisible dim) needs its explicit cross-replica
+        # sum — under check_rep=False nothing is implicit.
+        def red(g, s):
+            if _sharded_axis(s) >= 0:
+                return dcn_reduce_shard(g, SLICE_AXIS, dcn_dtype) \
+                    if hier else g
+            g = lax.psum(g, DATA_AXIS)
+            return lax.psum(g, SLICE_AXIS) if hier else g
+
+        return jax.tree_util.tree_map(red, grads, specs.params)
 
     def step(state, batch):
         return train_step_body(
@@ -296,13 +330,14 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
             lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
             on_mesh=True, gather_params=gather_params,
             reduce_grads=reduce_grads, tx=tx, accum_steps=accum_steps,
-            label_smoothing=label_smoothing,
+            label_smoothing=label_smoothing, axis_names=axis_names,
         )
 
+    batch_spec = P(squeeze_axes(axis_names))
     sharded = shard_map_nocheck(
         step,
         mesh=mesh,
-        in_specs=(specs, P(DATA_AXIS)),
+        in_specs=(specs, batch_spec),
         out_specs=(specs, P()),
     )
     return jax.jit(

@@ -189,7 +189,7 @@ class CheckpointManager:
     def __init__(self, directory: str = ".", keep: int = 3,
                  is_chief: bool = True, arch: str = "",
                  batch_size: Optional[int] = None, fault_plan=None,
-                 async_writer=None):
+                 async_writer=None, geometry=None):
         if keep < 1:
             raise ValueError(f"ckpt keep={keep} must be >= 1")
         self.directory = directory
@@ -199,6 +199,9 @@ class CheckpointManager:
         self.batch_size = batch_size
         self.fault_plan = fault_plan
         self.async_writer = async_writer
+        # (world_size, global_batch, accum) stamped into every step
+        # save so a changed-geometry --resume can name both tuples
+        self.geometry = geometry
 
     def save_step(self, state, *, epoch: int, step_in_epoch: int,
                   best_acc1: float = 0.0, sync: bool = False
@@ -256,6 +259,7 @@ class CheckpointManager:
                         step_in_epoch * self.batch_size
                         if self.batch_size is not None else None
                     ),
+                    geometry=self.geometry,
                 )
                 if self.fault_plan is not None and not remote:
                     # fault hooks (ckpt_truncate@save=N) count ACTUAL

@@ -181,6 +181,7 @@ def save_checkpoint(
     filename: str = CHECKPOINT_NAME,
     step_in_epoch: int = 0,
     data_position: Optional[int] = None,
+    geometry: Optional[tuple] = None,
 ) -> Optional[str]:
     """Serialize state; copy to model_best when ``is_best``. Chief-only.
 
@@ -188,9 +189,17 @@ def save_checkpoint(
     coordinates (dptpu/resilience): batches already consumed from epoch
     ``epoch`` and samples consumed per shard. 0 means an epoch boundary
     (the reference's only save point, imagenet_ddp.py:216-222).
+
+    ``geometry`` is the run's ``(world_size, global_batch, accum)``
+    tuple. Saving it lets a mid-epoch ``--resume`` under a CHANGED
+    batch geometry fail fast naming both the saved and current tuples
+    (the groundwork for elastic resume, ROADMAP item 3b: a remapper
+    needs exactly these coordinates) instead of a bare mismatch.
     """
     if not is_chief:
         return None
+    geom = tuple(int(g) for g in geometry) if geometry is not None \
+        else (-1, -1, -1)
     payload = {
         "epoch": epoch,
         "arch": arch,
@@ -208,6 +217,9 @@ def save_checkpoint(
         "data_position": int(
             data_position if data_position is not None else -1
         ),
+        "world_size": geom[0],
+        "global_batch": geom[1],
+        "accum_steps": geom[2],
     }
     # EVERY checkpoint write goes through the Store abstraction
     # (dptpu/data/store.py): a plain directory routes to LocalStore —
@@ -277,12 +289,17 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "qkv_layout": "",
         "step_in_epoch": 0,
         "data_position": -1,
+        "world_size": -1,
+        "global_batch": -1,
+        "accum_steps": -1,
     }
     # Optional bookkeeping fields, defaulted when absent so every older
     # payload generation parses: pre-round-4 files lack qkv_layout (and
     # get the ViT attention-column migration below), pre-resilience files
-    # lack the mid-epoch resume coordinates.
-    _OPTIONAL = ("qkv_layout", "step_in_epoch", "data_position")
+    # lack the mid-epoch resume coordinates, pre-hierarchy files lack
+    # the (world_size, global_batch, accum) geometry tuple.
+    _OPTIONAL = ("qkv_layout", "step_in_epoch", "data_position",
+                 "world_size", "global_batch", "accum_steps")
     # structural legacy detection, single decode: restore the msgpack
     # tree once (raises its precise error on a corrupt file), pick the
     # template by the payload's own top-level keys, and validate with
@@ -323,6 +340,12 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "training_time": float(payload["training_time"]),
         "step_in_epoch": int(payload["step_in_epoch"]),
         "data_position": int(payload["data_position"]),
+        # (world_size, global_batch, accum) at save time; (-1,-1,-1)
+        # for pre-hierarchy files (resume then falls back to the
+        # data_position cross-check)
+        "geometry": (int(payload["world_size"]),
+                     int(payload["global_batch"]),
+                     int(payload["accum_steps"])),
     }
     return new_state, meta
 
@@ -441,5 +464,6 @@ def _load_torch_checkpoint(path: str, state, arch: Optional[str],
         # the reference only saves on epoch boundaries
         "step_in_epoch": 0,
         "data_position": -1,
+        "geometry": (-1, -1, -1),
     }
     return new_state, meta

@@ -260,6 +260,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # large-batch engine knobs (optimizer / accumulation / warmup /
     # smoothing) fail fast pre-compile under the same locked contract
     opt_name, accum_steps, warmup_epochs, label_smooth = _opt_knobs(cfg)
+    # hierarchical-comms knobs (--slices/DPTPU_SLICES, DPTPU_DCN_DTYPE)
+    # fail fast pre-compile too; divisibility is checked against the
+    # device count once the mesh is factored below
+    from dptpu.parallel.hierarchy import hierarchy_knobs
+
+    slices, dcn_dtype = hierarchy_knobs(cfg)
     initialize_distributed(cfg)
     derived = derive(
         cfg,
@@ -278,6 +284,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         )
 
     single_device = cfg.gpu is not None or jax.device_count() == 1
+    # THE run geometry tuple, built once: stamped into every checkpoint
+    # (CheckpointManager / boundary saves) AND compared by the
+    # mid-epoch resume cross-check — one construction site, so the
+    # saved tuple and the checked tuple cannot desynchronize
+    run_geom = (derived.global_device_count, derived.global_batch_size,
+                accum_steps)
     # DPTPU_TP=N opens a model axis of size N on the mesh and routes
     # training through the GSPMD tensor-parallel step (specs picked by
     # arch below). The model axis is INNER: on multi-host pods the
@@ -384,6 +396,45 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             f"or unset DPTPU_SP to get data-parallel gradient "
             f"accumulation"
         )
+    # DPTPU_SLICES/--slices > 1: two-level hierarchical data
+    # parallelism (dptpu/parallel/hierarchy.py) — the gradient
+    # all-reduce decomposes into reduce-scatter(ICI) + shard-sized
+    # all-reduce(DCN) + all-gather(ICI). Composes with the default DDP
+    # step AND with DPTPU_ZERO1 (state shards over the intra-slice
+    # axis, so the weight all-gather stays on ICI); TP/SP/GSPMD keep
+    # their own single-level topologies (explicit requests win, with a
+    # notice — the repo-wide precedence discipline).
+    want_hier = slices > 1
+    want_gspmd_early = _os_environ_flag("DPTPU_GSPMD")
+    use_hier = (
+        want_hier and not single_device and not cfg.evaluate
+        and not use_tp and not use_sp and not want_gspmd_early
+        # a demoted TP request routes to the GSPMD dp_specs step, which
+        # derives its own collectives on a flat mesh
+        and not tp_fallback
+    )
+    if slices == 1 and _os_environ_int("DPTPU_SLICES") == 1 and verbose:
+        print("=> DPTPU_SLICES=1 is a no-op: one slice is the flat "
+              "single-level data mesh")
+    if want_hier and not use_hier and verbose:
+        why = (
+            "DPTPU_TP drives the GSPMD tensor-parallel step"
+            if use_tp
+            else "DPTPU_SP drives the sequence-parallel step"
+            if use_sp
+            else "DPTPU_GSPMD derives its own single-program collectives "
+                 "(hierarchical placement there is a follow-on)"
+            if (want_gspmd_early or tp_fallback)
+            and not single_device and not cfg.evaluate
+            else "--evaluate does not train"
+            if cfg.evaluate and not single_device
+            else "single-device run (no DCN hop to factor)"
+        )
+        print(f"=> DPTPU_SLICES={slices} ignored: {why}")
+    if dcn_dtype != "fp32" and not use_hier and verbose:
+        print(f"=> DPTPU_DCN_DTYPE={dcn_dtype} ignored: no hierarchical "
+              f"mesh (set DPTPU_SLICES >= 2), so there is no DCN-only "
+              f"hop to compress")
     if single_device:
         mesh = None
     elif use_tp:
@@ -395,6 +446,21 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         from dptpu.parallel.sequence import SEQ_AXIS
 
         mesh = make_mesh(mesh_shape={DATA_AXIS: -1, SEQ_AXIS: sp_n})
+    elif use_hier:
+        from dptpu.parallel import make_hierarchical_mesh
+
+        # raises when slices does not divide the device count (or the
+        # host count, multi-process) — the locked fail-fast contract
+        mesh = make_hierarchical_mesh(slices)
+        if verbose:
+            import jax as _jax
+
+            print(
+                f"=> hierarchical data parallelism: {slices} slices x "
+                f"{_jax.device_count() // slices} chips/slice — gradient "
+                f"reduction is reduce-scatter(ICI) + shard-sized "
+                f"all-reduce(DCN, {dcn_dtype}) + all-gather(ICI)"
+            )
     else:
         mesh = make_mesh()
     if cfg.multiprocessing_distributed and verbose:
@@ -587,14 +653,20 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     if use_gspmd and derived.sync_bn and verbose:
         print("=> --sync-bn is implicit under DPTPU_GSPMD: BatchNorm "
               "always sees the global batch in the single-program step")
+    # SyncBN spans EVERY replica: on a hierarchical mesh the BatchNorm
+    # statistics pmean over both data axes (slice × dp_in_slice) — the
+    # flax axis_name accepts the tuple like any jax collective
+    _bn_axis = None
+    if derived.sync_bn and mesh is not None and not use_gspmd:
+        from dptpu.parallel.mesh import data_axis_names, squeeze_axes
+
+        _bn_axis = squeeze_axes(data_axis_names(mesh))
     model = create_model(
         cfg.arch,
         pretrained=cfg.pretrained,
         num_classes=num_classes,
         dtype=compute_dtype,
-        bn_axis_name="data"
-        if (derived.sync_bn and mesh is not None and not use_gspmd)
-        else None,
+        bn_axis_name=_bn_axis,
         bn_dtype=jnp.float32 if keep_bn_fp32 else None,
         # space-to-depth stem: identical math + identical params (checkpoints
         # interchange freely; parity locked in tests/test_models.py). Opt-in
@@ -680,12 +752,33 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if cfg.start_epoch == 0:
                 start_epoch = meta["epoch"]
                 resume_step = max(int(meta.get("step_in_epoch", 0)), 0)
-                # geometry cross-check: the checkpoint's data_position
-                # (samples consumed per host) must agree with
-                # step x THIS run's host batch, or the mid-epoch replay
+                # geometry cross-check: a mid-epoch replay is only
+                # exact when the run that resumes has the SAME batch
+                # geometry as the run that saved. Checkpoints carry
+                # their (world_size, global_batch, accum) tuple, so the
+                # fail-fast names BOTH tuples — the coordinates an
+                # elastic-resume remapper (ROADMAP item 3b) would need
+                # — instead of a bare mismatch. Pre-geometry files fall
+                # back to the data_position cross-check below.
+                saved_geom = tuple(meta.get("geometry", (-1, -1, -1)))
+                if resume_step and saved_geom[0] >= 0 \
+                        and saved_geom != run_geom:
+                    raise ValueError(
+                        f"'{resolved}' was saved mid-epoch (step "
+                        f"{resume_step}) by a run with (world_size, "
+                        f"global_batch, accum) = {saved_geom}, but this "
+                        f"run is {run_geom} — the batch geometry "
+                        f"changed, so the exact mid-epoch replay is "
+                        f"impossible. Resume on the saved geometry, or "
+                        f"pass --start-epoch to restart from an epoch "
+                        f"boundary (elastic re-mapping onto a new "
+                        f"geometry is ROADMAP item 3b)."
+                    )
+                # legacy (pre-geometry) files: the checkpoint's
+                # data_position (samples consumed per host) must agree
+                # with step x THIS run's host batch, or the replay
                 # contract is void — resuming would re-train (or skip)
-                # part of the epoch silently. Fail fast, like every
-                # other misconfigured knob.
+                # part of the epoch silently.
                 meta_dp = int(meta.get("data_position", -1))
                 if resume_step and meta_dp >= 0 \
                         and meta_dp != resume_step * host_batch:
@@ -745,6 +838,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             tx_factory=partial(
                 make_optimizer, cfg.momentum, cfg.weight_decay, opt_name
             ),
+            dcn_dtype=dcn_dtype if use_hier else "fp32",
         )
         from dptpu.parallel import zero1_update_shard_bytes
 
@@ -832,6 +926,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             mesh, compute_dtype, lr_schedule=schedule,
             seed=cfg.seed if cfg.seed is not None else 0,
             accum_steps=accum_steps, label_smoothing=label_smooth,
+            dcn_dtype=dcn_dtype if use_hier else "fp32",
         )
         eval_view = lambda s: s  # noqa: E731
         eval_view_gathers = False
@@ -946,6 +1041,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         batch_size=host_batch,
         fault_plan=fault_plan,
         async_writer=ckpt_writer,
+        geometry=run_geom,
     )
     if fault_plan is not None:
         fault_plan.bind_worker_kill(train_loader.kill_one_worker)
@@ -1143,6 +1239,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     is_best=is_best,
                     is_chief=derived.is_chief,
                     directory=ckpt_dir,
+                    geometry=manager.geometry,
                 )
             if fault_plan is not None and boundary_path:
                 # boundary saves count toward ckpt_truncate@save=N too —
@@ -1260,6 +1357,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     is_chief=derived.is_chief,
                     training_time=training_time,
                     directory=ckpt_dir,
+                    geometry=manager.geometry,
                 )
                 if fault_plan is not None and early_path:
                     from dptpu.data.store import is_store_url as _is_url

@@ -36,7 +36,17 @@ except ImportError:  # pragma: no cover
 from dptpu.ops.loss import cross_entropy_loss
 from dptpu.ops.metrics import topk_correct_fraction
 from dptpu.ops.optimizers import trust_ratio_stats
-from dptpu.parallel.mesh import DATA_AXIS
+from dptpu.parallel.hierarchy import (
+    flat_replica_index,
+    is_hierarchical,
+    make_hierarchical_reduce,
+)
+from dptpu.parallel.mesh import (
+    DATA_AXIS,
+    data_axis_names,
+    data_parallel_width,
+    squeeze_axes,
+)
 
 
 def shard_map_nocheck(f, mesh, in_specs, out_specs):
@@ -105,7 +115,7 @@ def normalize_images(images, dtype=jnp.float32):
 def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
                     axis_size, on_mesh, gather_params=None,
                     reduce_grads=None, tx=None, accum_steps=1,
-                    label_smoothing=0.0):
+                    label_smoothing=0.0, axis_names=(DATA_AXIS,)):
     """The shared per-shard train-step math — ONE source of truth for the
     DDP step below, the ZeRO-1 step (dptpu/parallel/zero.py) and the
     GSPMD step (dptpu/parallel/gspmd.py), which differ only in their
@@ -135,10 +145,18 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
     ``tx`` overrides ``state.tx`` for the update (ZeRO-1 injects a
     shard-aware trust-ratio optimizer whose state structure matches).
     ``label_smoothing`` feeds the training loss only.
+
+    ``axis_names`` is the tuple of mesh axes the replicas span:
+    ``("data",)`` on the flat mesh, ``("slice", "data")`` on the
+    two-level hierarchical mesh (dptpu/parallel/hierarchy.py) — the
+    dropout replica id flattens over them slice-major (so it equals the
+    flat mesh's index for the same chip) and the BN-stat/metric pmeans
+    span all replicas either way.
     """
     labels = batch["labels"]
     step_key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
     tx = state.tx if tx is None else tx
+    pmean_axes = squeeze_axes(axis_names)
 
     def loss_and_grads(images_u8, labels_mb, dropout_key, denom):
         images = normalize_images(images_u8, compute_dtype)
@@ -173,7 +191,7 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
         dropout_key = step_key
         if on_mesh:
             dropout_key = jax.random.fold_in(
-                dropout_key, lax.axis_index(DATA_AXIS)
+                dropout_key, flat_replica_index(axis_names)
             )
         (loss, logits, new_stats), grads = loss_and_grads(
             batch["images"], labels, dropout_key, axis_size
@@ -194,7 +212,7 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
         # virtual-replica id: replica r, microbatch j acts like replica
         # r·k + j of a k×-wider pod — distinct dropout streams, same
         # resume-stable (seed, step) root
-        ax = lax.axis_index(DATA_AXIS) if on_mesh else 0
+        ax = flat_replica_index(axis_names) if on_mesh else 0
 
         def micro(carry, xs):
             g_acc, s_acc, m_acc = carry
@@ -242,7 +260,7 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
         # running BN stats + reported metrics: explicit cross-replica mean
         # (the reference's reduce_tensor, imagenet_ddp_apex.py:562-566)
         new_stats, loss, top1, top5 = lax.pmean(
-            (new_stats, loss, top1, top5), DATA_AXIS
+            (new_stats, loss, top1, top5), pmean_axes
         )
     # SGD's chain is elementwise, so it is equally valid on full params
     # (DDP) and ZeRO-1 shard-local slices; LARS/LAMB additionally need
@@ -277,7 +295,7 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
 
 def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
                     lr_schedule=None, seed: int = 0, accum_steps: int = 1,
-                    label_smoothing: float = 0.0):
+                    label_smoothing: float = 0.0, dcn_dtype: str = "fp32"):
     """Build the jitted train step.
 
     Returns ``step(state, batch) -> (state, metrics)`` where ``batch`` is a
@@ -303,18 +321,33 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
     (``--accum-steps`` / ``DPTPU_ACCUM``): each replica's batch splits
     into ``k`` fp32-accumulated microbatches before the one optimizer
     update, emulating a pod ``k×`` wider (see ``train_step_body``).
+
+    On a hierarchical ``{slice, data}`` mesh
+    (``make_hierarchical_mesh``) the gradient reduction decomposes into
+    reduce-scatter(ICI) → shard-sized all-reduce(DCN) → all-gather(ICI)
+    per leaf (dptpu/parallel/hierarchy.py), with ``dcn_dtype="bf16"``
+    compressing the DCN hop (fp32 accumulation). Under accumulation the
+    whole three-hop reduction still runs ONCE per update, after the
+    microbatch scan — never per microbatch.
     """
 
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
-    # Gradient normalizer: the data-axis size, NOT mesh.size. The
-    # explicit psum below spans exactly the data axis even when inner
-    # axes (e.g. {"data": N, "model": M}) are open — the model-axis
-    # duplicates compute identical grads and must NOT be summed. Locked
-    # by tests/test_train_step.py::test_axes_open_mesh_matches_single_device.
-    axis_size = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    # Gradient normalizer: the data axes' size, NOT mesh.size. The
+    # explicit psum below spans exactly the data axis (both data axes on
+    # a hierarchical mesh) even when inner axes (e.g. {"data": N,
+    # "model": M}) are open — the model-axis duplicates compute
+    # identical grads and must NOT be summed. Locked by
+    # tests/test_train_step.py::test_axes_open_mesh_matches_single_device.
+    axis_names = data_axis_names(mesh) if mesh is not None else (DATA_AXIS,)
+    axis_size = data_parallel_width(mesh)
+    hier = is_hierarchical(mesh)
     reduce_grads = None
-    if mesh is not None:
+    if hier:
+        # the two-level reduction: per-chip DCN bytes ~1/dp_in_slice of
+        # the flat all-reduce (the Mikami/Yamazaki hierarchy)
+        reduce_grads = make_hierarchical_reduce(mesh, dcn_dtype)
+    elif mesh is not None:
         # the DDP all-reduce, placed explicitly (see shard_map_nocheck):
         # grads arrive as d(local_mean/axis_size), so the psum IS the
         # global-batch-mean gradient
@@ -326,15 +359,17 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
             lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
             on_mesh=mesh is not None, reduce_grads=reduce_grads,
             accum_steps=accum_steps, label_smoothing=label_smoothing,
+            axis_names=axis_names,
         )
 
     opts = tpu_compiler_options()
     if mesh is None:
         return jax.jit(step, donate_argnums=0, compiler_options=opts)
+    batch_spec = P(squeeze_axes(axis_names))
     sharded = shard_map_nocheck(
         step,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS)),
+        in_specs=(P(), batch_spec),
         out_specs=(P(), P()),
     )
     return jax.jit(sharded, donate_argnums=0, compiler_options=opts)
@@ -370,7 +405,7 @@ def make_eval_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32):
             "count": mask.sum(),
         }
         if mesh is not None:
-            sums = lax.psum(sums, DATA_AXIS)
+            sums = lax.psum(sums, squeeze_axes(data_axis_names(mesh)))
         return sums
 
     opts = tpu_compiler_options()
@@ -379,7 +414,7 @@ def make_eval_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32):
     sharded = shard_map_nocheck(
         step,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS)),
+        in_specs=(P(), P(squeeze_axes(data_axis_names(mesh)))),
         out_specs=P(),
     )
     return jax.jit(sharded, compiler_options=opts)

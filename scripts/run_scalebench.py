@@ -85,56 +85,14 @@ def _ensure_cpu_pool(n: int):
 
 
 def _collective_bytes_per_chip(hlo_text: str, n: int) -> dict:
-    """Per-chip collective bytes per step from optimized HLO: for each
-    all-gather / reduce-scatter / all-reduce, count the bytes this chip
-    SENDS on a ring. Shapes in the HLO are RESULT shapes: all-gather's
-    result is the full gathered array (chip sends (N-1)/N of it),
-    reduce-scatter's result is the scattered 1/N slice (chip sends
-    (N-1)x the result — (N-1)/N of the full input), all-reduce's equals
-    its input (2·(N-1)/N for the reduce-scatter + all-gather phases)."""
-    itemsize = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
-                "pred": 1, "u8": 1, "f64": 8, "s8": 1}
-    out = {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0,
-           "instructions": 0}
-    frac = (n - 1) / n if n > 1 else 0.0
-    for line in hlo_text.splitlines():
-        # result shapes may be nested tuples (combined async collectives:
-        # '((f32[a], f32[b]), (f32[c], f32[d])) all-gather-start(...)'),
-        # so collect every dtype[dims] token left of the op name instead
-        # of splitting one paren level; '-done' carries the same payload
-        # its '-start' already counted
-        m = re.search(
-            r"=\s+(.*?)\s+"
-            r"(all-gather|reduce-scatter|all-reduce)(-start|-done)?\(",
-            line)
-        if not m:
-            continue
-        result_part, op, suffix = m.groups()
-        if suffix == "-done":
-            continue
-        shapes = []
-        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", result_part):
-            size = 1
-            for d in dims.split(","):
-                if d:
-                    size *= int(d)
-            shapes.append(size * itemsize.get(dt, 4))
-        if suffix == "-start" and len(shapes) > 1:
-            # async '-start' results are (operands..., results...) pairs:
-            # only the result half is payload — summing both would count
-            # every async collective twice
-            shapes = shapes[len(shapes) // 2:]
-        nbytes = sum(shapes)
-        out["instructions"] += 1
-        if op == "all-gather":
-            out["all-gather"] += int(nbytes * frac)
-        elif op == "reduce-scatter":
-            out["reduce-scatter"] += int(nbytes * (n - 1))
-        else:
-            out["all-reduce"] += int(nbytes * 2 * frac)
-    out["total"] = (out["all-gather"] + out["reduce-scatter"]
-                    + out["all-reduce"])
-    return out
+    """The r06 per-chip collective accounting, now the SHARED parser
+    (dptpu/parallel/hlo_accounting.py — COMMBENCH and the HLO-level
+    regression locks read the same implementation, so the bench and its
+    locks cannot diverge). Semantics unchanged: per-op-kind bytes one
+    chip sends on an n-wide ring, result shapes as HLO writes them."""
+    from dptpu.parallel.hlo_accounting import collective_bytes_per_chip
+
+    return collective_bytes_per_chip(hlo_text, n)
 
 
 def _median_time(fn, reps: int, fence) -> float:

@@ -61,6 +61,13 @@ _FAST_MODULES = {
     # the databench smoke is the fourth fit-shaped exception (one
     # subprocess, --smoke preset, same gates as DATABENCH.json)
     "test_shards", "test_store", "test_databench_smoke",
+    # hierarchical comms (PR 10): knob/parser units are pure; the
+    # parity + HLO locks compile only TinyDense-sized shard_map steps
+    # (the test_optimizers precedent) and hold the ISSUE acceptance
+    # bars — pure-hop Δ=0 parity and per-axis byte counts MUST hold in
+    # tier 1; the commbench smoke is the fifth fit-shaped exception
+    # (one subprocess, --smoke preset, same gates as COMMBENCH.json)
+    "test_hierarchy", "test_commbench_smoke",
 }
 
 

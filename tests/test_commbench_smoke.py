@@ -1,0 +1,55 @@
+"""Tier-1 smoke of scripts/run_commbench.py (the obsbench pattern):
+the hierarchical-comms gates — per-chip DCN bytes <= 1.1x the ideal
+1/chips_per_slice of the flat all-reduce, the bf16-DCN halving, and
+the hierarchical-vs-flat fp32 parity gate (params Δ=0 after 5 steps on
+the pure-hop geometries) — are continuously checked, not just on the
+bench host. One subprocess, --smoke preset, same gate logic as the
+committed COMMBENCH.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_commbench_smoke_gates(tmp_path):
+    out = str(tmp_path / "COMMBENCH.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the bench needs >= slices x chips_per_slice virtual devices; the
+    # harness's 8-device XLA_FLAGS (conftest) covers the 2x2 preset,
+    # and the script re-execs itself if the pool is too small
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_commbench.py"),
+         "--smoke", "--slices", "2", "--chips-per-slice", "2",
+         "--per-chip-batch", "8", "--steps", "5", "--out", out],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"commbench gate failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+    with open(out) as f:
+        bench = json.load(f)
+    # artifact schema: every consumer-facing section present
+    for key in ("flat_allreduce_per_chip", "hier_fp32_by_link",
+                "hier_bf16_by_link_preopt", "bf16_limitation",
+                "dcn_vs_ideal_ratio", "bf16_dcn_vs_fp32_dcn_ratio",
+                "parity", "gates", "host"):
+        assert key in bench, key
+    gates = bench["gates"]
+    assert gates["dcn_bytes_ok"], bench["dcn_vs_ideal_ratio"]
+    assert gates["bf16_halving_ok"], bench["bf16_dcn_vs_fp32_dcn_ratio"]
+    assert gates["parity_ok"], bench["parity"]
+    # the Δ=0 claims specifically (not just the rolled-up gate)
+    assert bench["parity"]["fp32_pure_ici_max_delta"] == 0.0
+    assert bench["parity"]["fp32_pure_dcn_max_delta"] == 0.0
+    assert bench["parity"]["steps"] >= 5
+    # per-link accounting is structurally sane: the hierarchical DCN
+    # hop is all-reduce-only and strictly smaller than the flat total
+    hier = bench["hier_fp32_by_link"]
+    assert hier["dcn"]["reduce-scatter"] == 0
+    assert hier["dcn"]["total"] < bench["flat_allreduce_per_chip"]["total"]

@@ -79,11 +79,48 @@ def test_sigterm_midepoch_resume_is_bit_identical(baseline, tmp_path,
     assert os.path.exists(step_checkpoint_name(0, 2))
 
     monkeypatch.delenv("DPTPU_FAULT")
-    # a changed batch geometry voids the replay contract — fail fast
-    # (data_position cross-check), never resume at a silently-wrong
-    # data position
-    with pytest.raises(ValueError, match="batch geometry changed"):
+    # a changed batch geometry voids the replay contract — fail fast,
+    # and the message names BOTH the saved and the current (world_size,
+    # global_batch, accum) tuples (the coordinates an elastic-resume
+    # remapper needs, ROADMAP item 3b) — locked here so a reworded
+    # error cannot degrade back to a bare mismatch
+    with pytest.raises(ValueError, match="batch geometry changed") as ei:
         fit(_cfg(resume=".", batch_size=12), image_size=32, verbose=False)
+    msg = str(ei.value)
+    # (derive() counts the 8 fake local devices even on the gpu-pinned
+    # path; what matters is that save and resume agree on the frame)
+    assert "(8, 24, 1)" in msg  # the SAVED (world, global_batch, accum)
+    assert "(8, 8, 1)" in msg   # the CURRENT tuple (12//8 -> 1/chip)
+    assert "world_size" in msg and "global_batch" in msg
+    # a changed accumulation depth alone is ALSO a geometry change:
+    # the virtual-replica microbatch streams differ, so the replay
+    # would diverge silently (accum=3 divides the 3/chip batch, so the
+    # geometry check is the FIRST error hit)
+    monkeypatch.setenv("DPTPU_ACCUM", "3")
+    with pytest.raises(ValueError, match="batch geometry changed") as ei:
+        fit(_cfg(resume="."), image_size=32, verbose=False)
+    assert "(8, 24, 3)" in str(ei.value)
+    monkeypatch.delenv("DPTPU_ACCUM")
+    # LEGACY (pre-geometry) checkpoints — world_size absent — still get
+    # the data_position cross-check: the tuple check stands down and
+    # the fallback fires on a position that disagrees with
+    # step x THIS run's host batch (a batch-18 run's 2x18=36 samples
+    # resumed at batch 24 expects 2x24=48)
+    from dptpu.train.checkpoint import save_checkpoint
+
+    # in a SIBLING dir so the newest-mtime scan of "." below still
+    # resolves the real preemption save, not this synthetic file
+    legacy = os.path.join("legacy", step_checkpoint_name(0, 2))
+    save_checkpoint(
+        baseline["state"], epoch=0, arch="resnet18", best_acc1=0.0,
+        is_best=False, directory="legacy",
+        filename=step_checkpoint_name(0, 2), step_in_epoch=2,
+        data_position=36, geometry=None,
+    )
+    with pytest.raises(ValueError,
+                       match="samples consumed per host") as ei:
+        fit(_cfg(resume=legacy), image_size=32, verbose=False)
+    assert "batch geometry changed" in str(ei.value)
     r2 = fit(_cfg(resume="."), image_size=32, verbose=False)
     assert r2["preempted"] is False
     assert r2["epochs_run"] == 2  # epoch 0 (resumed mid-way) + epoch 1

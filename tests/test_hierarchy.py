@@ -1,0 +1,474 @@
+"""Two-level ICI/DCN hierarchical gradient comms
+(dptpu/parallel/hierarchy.py) on the fake 8-device pod.
+
+Locks, per ISSUE 10:
+
+* knob fail-fast contract for DPTPU_SLICES / DPTPU_DCN_DTYPE (the
+  tests/test_opt_knobs.py pattern);
+* HLO-level regression locks — flat DDP and ZeRO-1 collective bytes
+  unchanged vs the SCALEBENCH r06 accounting (now the shared parser in
+  dptpu/parallel/hlo_accounting.py), the hierarchical path emits
+  exactly reduce-scatter + all-reduce + all-gather with the expected
+  per-axis byte counts, and the bf16-DCN arm halves the cross-slice
+  bytes (pre-optimization HLO — this CPU backend's float normalization
+  promotes bf16 collectives, see hlo_accounting docstring);
+* parity — each hop of the hierarchy is BIT-IDENTICAL to the flat DDP
+  step in isolation (pure-ICI and pure-DCN geometries, Δ=0 over 5
+  steps), the composed geometry is exact-to-grouping (1-step delta at
+  ulp scale; the flat all-reduce folds ranks linearly where the
+  hierarchy sums slice partials first, so bitwise composed equality is
+  arithmetically impossible — the COMMBENCH parity_note), ZeRO-1
+  composition is exact (hier-ZeRO-1 ≡ hier-DDP at Δ=0), and gradient
+  accumulation keeps ONE hierarchical reduction per update.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from dptpu.parallel import (
+    data_axis_names,
+    data_parallel_width,
+    gather_state,
+    hierarchy_knobs,
+    make_hierarchical_mesh,
+    make_mesh,
+    make_zero1_train_step,
+    replicated_sharding,
+    shard_host_batch,
+    shard_zero1_state,
+)
+from dptpu.parallel.hlo_accounting import (
+    collective_bytes_by_link,
+    collective_bytes_per_chip,
+    parse_collectives,
+    preopt_hlo_text,
+)
+from dptpu.train import create_train_state, make_optimizer, make_train_step
+
+
+class TinyDense(nn.Module):
+    """Dense-heavy (the test_zero1 pattern): channel dims divide 2/4/8
+    so leaves scatter at every geometry; BN exercises the replicated
+    batch_stats pmean."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def _state():
+    tx = make_optimizer(momentum=0.9, weight_decay=1e-4)
+    return create_train_state(
+        jax.random.PRNGKey(0), TinyDense(), tx, input_shape=(1, 8, 8, 3)
+    )
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "images": rng.randint(0, 256, (n, 8, 8, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 10, (n,)).astype(np.int32),
+    }
+
+
+def _replicate(state, mesh):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, replicated_sharding(mesh)), state
+    )
+
+
+def _run(mesh, steps=5, zero1=False, **kw):
+    st = _state()
+    if zero1:
+        step = make_zero1_train_step(mesh, st, **kw)
+        st = shard_zero1_state(st, mesh)
+    else:
+        step = make_train_step(mesh, **kw)
+        st = _replicate(st, mesh)
+    for i in range(steps):
+        st, m = step(st, shard_host_batch(_batch(16, seed=i), mesh))
+    if zero1:
+        st = gather_state(st, mesh)
+    return jax.device_get(st.params), m
+
+
+def _max_delta(a, b):
+    return max(
+        float(np.abs(np.asarray(x) - np.asarray(y)).max())
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b))
+    )
+
+
+# ---------------------------------------------------------------- knobs
+
+
+class _Cfg:
+    def __init__(self, slices=1):
+        self.slices = slices
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ("DPTPU_SLICES", "DPTPU_DCN_DTYPE"):
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_knob_defaults_are_flat():
+    assert hierarchy_knobs(_Cfg()) == (1, "fp32")
+    assert hierarchy_knobs(None) == (1, "fp32")
+
+
+def test_env_overrides_config(monkeypatch):
+    monkeypatch.setenv("DPTPU_SLICES", "4")
+    monkeypatch.setenv("DPTPU_DCN_DTYPE", "bf16")
+    assert hierarchy_knobs(_Cfg(slices=2)) == (4, "bf16")
+
+
+def test_slices_zero_negative_garbage_raise(monkeypatch):
+    for bad in ("0", "-2"):
+        monkeypatch.setenv("DPTPU_SLICES", bad)
+        with pytest.raises(ValueError, match="DPTPU_SLICES"):
+            hierarchy_knobs(_Cfg())
+    monkeypatch.setenv("DPTPU_SLICES", "two")
+    with pytest.raises(ValueError, match="not an integer"):
+        hierarchy_knobs(_Cfg())
+    monkeypatch.delenv("DPTPU_SLICES")
+    # the config field hits the same validation as the env twin
+    with pytest.raises(ValueError, match="--slices"):
+        hierarchy_knobs(_Cfg(slices=0))
+
+
+def test_dcn_dtype_whitelist(monkeypatch):
+    for bad in ("f16", "fp16", "int8", "FP32"):
+        monkeypatch.setenv("DPTPU_DCN_DTYPE", bad)
+        with pytest.raises(ValueError, match="DPTPU_DCN_DTYPE"):
+            hierarchy_knobs(_Cfg())
+    monkeypatch.setenv("DPTPU_DCN_DTYPE", "bf16")
+    assert hierarchy_knobs(_Cfg())[1] == "bf16"
+
+
+def test_slices_must_divide_world(eight_devices):
+    with pytest.raises(ValueError, match="does not divide"):
+        make_hierarchical_mesh(3, eight_devices)
+    m = make_hierarchical_mesh(2, eight_devices)
+    assert m.axis_names == ("slice", "data")
+    assert dict(m.shape) == {"slice": 2, "data": 4}
+    assert data_axis_names(m) == ("slice", "data")
+    assert data_parallel_width(m) == 8
+    flat = make_mesh(eight_devices, {"data": 8})
+    assert data_axis_names(flat) == ("data",)
+    assert data_parallel_width(flat) == 8
+
+
+def test_zero1_dcn_dtype_validated(eight_devices):
+    mesh = make_hierarchical_mesh(2, eight_devices[:4])
+    with pytest.raises(ValueError, match="dcn_dtype"):
+        make_zero1_train_step(mesh, _state(), dcn_dtype="fp16")
+
+
+def test_hier_batch_round_trips(eight_devices):
+    """shard_host_batch on the two-level mesh reassembles the SAME
+    global batch (slice-major row placement, replica r's rows on the
+    same chip as the flat layout)."""
+    mesh = make_hierarchical_mesh(2, eight_devices[:4])
+    b = _batch(16)
+    sb = shard_host_batch(b, mesh)
+    np.testing.assert_array_equal(np.asarray(sb["images"]), b["images"])
+    np.testing.assert_array_equal(np.asarray(sb["labels"]), b["labels"])
+
+
+# ------------------------------------------------------------ parity
+
+
+def test_pure_ici_geometry_is_bit_identical_to_flat(eight_devices):
+    """1 slice × 4 chips: reduce-scatter + all-gather IS the
+    all-reduce — params Δ=0 against the flat DDP step after 5 steps
+    (XLA's all-reduce and reduce-scatter both fold ranks linearly)."""
+    flat = make_mesh(eight_devices[:4], {"data": 4})
+    hier = make_hierarchical_mesh(1, eight_devices[:4])
+    pf, _ = _run(flat)
+    ph, _ = _run(hier)
+    assert _max_delta(pf, ph) == 0.0
+
+
+def test_pure_dcn_geometry_is_bit_identical_to_flat(eight_devices):
+    """4 slices × 1 chip: the slice-axis psum IS the all-reduce —
+    params Δ=0 after 5 steps."""
+    flat = make_mesh(eight_devices[:4], {"data": 4})
+    hier = make_hierarchical_mesh(4, eight_devices[:4])
+    pf, _ = _run(flat)
+    ph, _ = _run(hier)
+    assert _max_delta(pf, ph) == 0.0
+
+
+def test_composed_geometry_is_exact_to_grouping(eight_devices):
+    """2×2 (and 2×4) vs flat: the two-level reduction sums slice
+    partials first where the flat all-reduce folds ranks linearly, so
+    bitwise equality is arithmetically impossible — the one-step delta
+    must be ulp-scale (pure grouping, no trajectory amplification) and
+    the 5-step trajectory must stay in the same regime."""
+    for s, n in ((2, 4), (2, 8), (4, 8)):
+        flat = make_mesh(eight_devices[:n], {"data": n})
+        hier = make_hierarchical_mesh(s, eight_devices[:n])
+        pf1, _ = _run(flat, steps=1)
+        ph1, _ = _run(hier, steps=1)
+        scale = max(
+            float(np.abs(np.asarray(p)).max())
+            for p in jax.tree_util.tree_leaves(pf1)
+        )
+        assert _max_delta(pf1, ph1) <= 1e-6 * scale, (s, n)
+        pf, _ = _run(flat)
+        ph, _ = _run(hier)
+        for a, b in zip(jax.tree_util.tree_leaves(pf),
+                        jax.tree_util.tree_leaves(ph)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4
+            )
+
+
+def test_bf16_dcn_drift_is_bounded(eight_devices):
+    """bf16 compression rounds each slice partial once (fp32
+    accumulate): one-step drift is lr x bf16-eps x grad scale."""
+    flat = make_mesh(eight_devices[:4], {"data": 4})
+    hier = make_hierarchical_mesh(2, eight_devices[:4])
+    pf1, _ = _run(flat, steps=1)
+    pb1, _ = _run(hier, steps=1, dcn_dtype="bf16")
+    scale = max(
+        float(np.abs(np.asarray(p)).max())
+        for p in jax.tree_util.tree_leaves(pf1)
+    )
+    assert _max_delta(pf1, pb1) <= 5e-3 * scale
+
+
+def test_zero1_hier_composition_is_exact(eight_devices):
+    """Hierarchical ZeRO-1 ≡ hierarchical DDP at params Δ=0 (SGD):
+    the all-gather VJP IS the intra-slice reduce-scatter, the DCN hop
+    is the same shard-sized collective, and the update is elementwise
+    — same grouping, bit for bit. Holds for the bf16-DCN arm too."""
+    hier = make_hierarchical_mesh(2, eight_devices[:4])
+    pd, md = _run(hier)
+    pz, mz = _run(hier, zero1=True)
+    assert _max_delta(pd, pz) == 0.0
+    assert float(md["loss"]) == float(mz["loss"])
+    pdb, _ = _run(hier, dcn_dtype="bf16")
+    pzb, _ = _run(hier, zero1=True, dcn_dtype="bf16")
+    assert _max_delta(pdb, pzb) == 0.0
+
+
+def test_accum_composes_one_reduction_per_update(eight_devices):
+    """Gradient accumulation on the hierarchical mesh: the pure-ICI
+    geometry stays bit-identical to flat under accum=2 (same scan,
+    same single post-scan reduction), and the compiled accum=2 program
+    emits EXACTLY as many reduce-scatter/all-gather/all-reduce
+    instructions as accum=1 — the hierarchical reduction runs once per
+    UPDATE, never per microbatch."""
+    flat = make_mesh(eight_devices[:4], {"data": 4})
+    hier1 = make_hierarchical_mesh(1, eight_devices[:4])
+    pf, _ = _run(flat, accum_steps=2)
+    ph, _ = _run(hier1, accum_steps=2)
+    assert _max_delta(pf, ph) == 0.0
+
+    hier = make_hierarchical_mesh(2, eight_devices[:4])
+
+    def _counts(accum):
+        step = make_train_step(hier, accum_steps=accum)
+        st = _replicate(_state(), hier)
+        b = shard_host_batch(_batch(16), hier)
+        txt = step.lower(st, b).compile().as_text()
+        insts = parse_collectives(txt)
+        return {
+            op: sum(1 for i in insts if i["op"] == op)
+            for op in ("reduce-scatter", "all-gather", "all-reduce")
+        }
+
+    assert _counts(1) == _counts(2)
+
+
+# ------------------------------------------------- HLO byte accounting
+
+
+def _grad_bytes(state):
+    return 4 * sum(
+        int(np.prod(l.shape)) if l.shape else 1
+        for l in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+def _pmean_bytes(state):
+    # BN stats + the 3 pmean'd scalar metrics (loss/top1/top5)
+    return 4 * (sum(
+        int(np.prod(l.shape)) if l.shape else 1
+        for l in jax.tree_util.tree_leaves(state.batch_stats)
+    ) + 3)
+
+
+def test_flat_ddp_accounting_unchanged_vs_r06(eight_devices):
+    """The SCALEBENCH r06 lock: the flat DDP step emits ONLY
+    all-reduce (no reduce-scatter/all-gather), and its per-chip bytes
+    equal 2(n-1)/n × (gradient + BN-stat/metric pmean payload)."""
+    n = 4
+    flat = make_mesh(eight_devices[:n], {"data": n})
+    step = make_train_step(flat)
+    st = _replicate(_state(), flat)
+    b = shard_host_batch(_batch(16), flat)
+    txt = step.lower(st, b).compile().as_text()
+    acc = collective_bytes_per_chip(txt, n)
+    assert acc["reduce-scatter"] == 0
+    assert acc["all-gather"] == 0
+    expected = 2 * (n - 1) / n * (_grad_bytes(st) + _pmean_bytes(st))
+    assert abs(acc["all-reduce"] - expected) / expected < 0.02
+    # the group-aware view agrees with the r06 global-n view on flat
+    # programs (one world-spanning group per collective)
+    link = collective_bytes_by_link(txt, lambda p: p // 2, n)
+    assert link["total"] == acc["total"]
+    # ...and a topology-blind all-reduce is entirely DCN-crossing
+    assert link["ici"]["total"] == 0
+
+
+def test_zero1_flat_accounting_unchanged_vs_r06(eight_devices):
+    """ZeRO-1's all-gather + reduce-scatter volume still equals the
+    DDP all-reduce (the r06 equivalence) under the shared parser."""
+    n = 4
+    flat = make_mesh(eight_devices[:n], {"data": n})
+    st0 = _state()
+    zstep = make_zero1_train_step(flat, st0)
+    st = shard_zero1_state(st0, flat)
+    b = shard_host_batch(_batch(16), flat)
+    ztxt = zstep.lower(st, b).compile().as_text()
+    zacc = collective_bytes_per_chip(ztxt, n)
+
+    dstep = make_train_step(flat)
+    dtxt = dstep.lower(
+        _replicate(_state(), flat), b
+    ).compile().as_text()
+    dacc = collective_bytes_per_chip(dtxt, n)
+    # AG+RS (sharded leaves) + AR (replicated remainder + pmeans)
+    assert abs(zacc["total"] - dacc["total"]) / dacc["total"] < 0.001
+
+
+def test_hier_emits_rs_ar_ag_with_expected_per_axis_bytes(eight_devices):
+    """The hierarchical step emits exactly the three-op decomposition:
+    reduce-scatter + all-gather on ICI (intra-slice groups), the
+    shard-sized all-reduce crossing slices — with per-axis bytes
+    matching the analytic formulas."""
+    S, I = 2, 2
+    n = S * I
+    hier = make_hierarchical_mesh(S, eight_devices[:n])
+    step = make_train_step(hier)
+    st = _replicate(_state(), hier)
+    b = shard_host_batch(_batch(16), hier)
+    txt = step.lower(st, b).compile().as_text()
+    link = collective_bytes_by_link(txt, lambda p: p // I, n)
+    # every TinyDense leaf has a dim divisible by I=2 → everything
+    # scatters: ICI carries RS+AG only, DCN carries AR only
+    assert link["ici"]["reduce-scatter"] > 0
+    assert link["ici"]["all-gather"] > 0
+    assert link["ici"]["all-reduce"] == 0
+    assert link["dcn"]["reduce-scatter"] == 0
+    assert link["dcn"]["all-gather"] == 0
+    assert link["dcn"]["all-reduce"] > 0
+    g = _grad_bytes(st)
+    # ICI: (I-1)/I·G reduce-scatter + (I-1)/I·G all-gather
+    exp_ici = 2 * (I - 1) / I * g
+    assert abs(link["ici"]["total"] - exp_ici) / exp_ici < 0.02
+    # DCN: shard-sized all-reduce 2(S-1)/S·G/I, plus the (tiny)
+    # world-spanning pmean
+    exp_dcn = 2 * (S - 1) / S * g / I + 2 * (n - 1) / n * _pmean_bytes(st)
+    assert abs(link["dcn"]["total"] - exp_dcn) / exp_dcn < 0.02
+    # the headline: DCN bytes <= 1.1x the ideal flat/I
+    flat = make_mesh(eight_devices[:n], {"data": n})
+    ftxt = make_train_step(flat).lower(
+        _replicate(_state(), flat), b
+    ).compile().as_text()
+    flat_total = collective_bytes_per_chip(ftxt, n)["total"]
+    assert link["dcn"]["total"] <= 1.1 * flat_total / I
+
+
+def test_bf16_dcn_halves_the_crossing_bytes(eight_devices):
+    """In PRE-OPTIMIZATION HLO (the wire dtype this CPU backend's
+    float normalization erases from optimized text) the bf16 arm's
+    DCN bytes are ~half the fp32 arm's."""
+    S, I = 2, 2
+    n = S * I
+    hier = make_hierarchical_mesh(S, eight_devices[:n])
+    st = _replicate(_state(), hier)
+    b = shard_host_batch(_batch(16), hier)
+    pre = {}
+    for dtype in ("fp32", "bf16"):
+        step = make_train_step(hier, dcn_dtype=dtype)
+        pre[dtype] = collective_bytes_by_link(
+            preopt_hlo_text(step.lower(st, b)), lambda p: p // I, n
+        )
+    ratio = pre["bf16"]["dcn"]["total"] / pre["fp32"]["dcn"]["total"]
+    assert 0.45 <= ratio <= 0.55
+    # ICI stays full-precision and identical
+    assert pre["bf16"]["ici"]["total"] == pre["fp32"]["ici"]["total"]
+
+
+# ---------------------------------------------------- parser unit tests
+
+
+def test_parse_groups_explicit_and_iota():
+    explicit = ("  %ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+                "replica_groups={{0,1},{2,3}}, to_apply=%sum")
+    iota = ("  %ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+            "replica_groups=[2,2]<=[4], to_apply=%sum")
+    iota_t = ("  %ar = f32[8]{0} all-reduce(f32[8]{0} %x), "
+              "replica_groups=[2,2]<=[2,2]T(1,0), to_apply=%sum")
+    assert parse_collectives(explicit)[0]["groups"] == [[0, 1], [2, 3]]
+    assert parse_collectives(iota)[0]["groups"] == [[0, 1], [2, 3]]
+    assert parse_collectives(iota_t)[0]["groups"] == [[0, 2], [1, 3]]
+
+
+def test_send_byte_formulas_on_synthetic_hlo():
+    hlo = "\n".join([
+        # all-gather: result 64 f32 = 256B, intra-slice groups of 2
+        "  %ag = f32[64]{0} all-gather(f32[32]{0} %a), "
+        "replica_groups={{0,1},{2,3}}, dimensions={0}",
+        # reduce-scatter: result 16 f32 = 64B, intra-slice groups of 2
+        "  %rs = f32[16]{0} reduce-scatter(f32[32]{0} %b), "
+        "replica_groups={{0,1},{2,3}}, dimensions={0}, to_apply=%s",
+        # all-reduce bf16: result 32 bf16 = 64B, slice-crossing groups
+        "  %ar = bf16[32]{0} all-reduce(bf16[32]{0} %c), "
+        "replica_groups={{0,2},{1,3}}, to_apply=%s",
+    ])
+    # r06 semantics: ring width is the GLOBAL n for every instruction
+    acc = collective_bytes_per_chip(hlo, 4)
+    assert acc["all-gather"] == 192        # 3/4 x 256
+    assert acc["reduce-scatter"] == 192    # 3 x 64
+    assert acc["all-reduce"] == int(64 * 2 * 3 / 4)
+    # group-aware view: ring width is the GROUP size, link class from
+    # slice membership (slice_of = p // 2)
+    link = collective_bytes_by_link(hlo, lambda p: p // 2, 4)
+    assert link["ici"]["all-gather"] == 128       # 1/2 x 256
+    assert link["ici"]["reduce-scatter"] == 64    # 1 x 64
+    assert link["ici"]["all-reduce"] == 0
+    assert link["dcn"]["all-reduce"] == 64        # 2 x 1/2 x 64
+    assert link["dcn"]["instructions"] == 1
+
+
+def test_async_start_done_counted_once():
+    hlo = "\n".join([
+        "  %s = (f32[16]{0}, f32[64]{0}) all-gather-start(f32[16]{0} "
+        "%a), replica_groups={{0,1,2,3}}, dimensions={0}",
+        "  %d = f32[64]{0} all-gather-done((f32[16]{0}, f32[64]{0}) "
+        "%s)",
+    ])
+    acc = collective_bytes_per_chip(hlo, 4)
+    assert acc["instructions"] == 1
+    assert acc["all-gather"] == 192  # only the result half, once

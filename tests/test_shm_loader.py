@@ -237,6 +237,95 @@ def test_affinity_spans_cover_batch_and_balance():
         assert _affinity_of(i, 4) == _affinity_of(i, 4)
 
 
+def test_shard_affinity_routes_whole_shard_to_one_worker():
+    """Shard-level decode-cache affinity (ISSUE 10 satellite): with an
+    ``affinity_key`` (a packed-shard dataset's ``shard_of``), every
+    sample of one shard hashes to the SAME worker — stable in the
+    SHARD id, so the routing survives any sampler reshuffle — up to
+    the ceil(B/N) rebalance cap (utilization still beats affinity for
+    overflow)."""
+    from dptpu.data.shm import _affinity_of, _affinity_spans
+
+    shard_of = lambda i: i // 16  # noqa: E731 — 16-sample shards
+    # pick 4 shards that hash to 4 DISTINCT workers (no collision, so
+    # no rebalance overflow): each worker gets exactly ceil(B/N) and
+    # every shard must stay whole
+    shards, targets = [], set()
+    for s in range(64):
+        w = _affinity_of(s, 4)
+        if w not in targets:
+            targets.add(w)
+            shards.append(s)
+        if len(shards) == 4:
+            break
+    idxs = [s * 16 + j for j in range(8) for s in shards]  # interleaved
+    spans = _affinity_spans(idxs, 4, shard_of)
+    worker_of = {}
+    for wid, offsets, span_idxs in spans:
+        assert len(offsets) <= -(-len(idxs) // 4)  # rebalance cap holds
+        for i in span_idxs:
+            worker_of[i] = wid
+    assert sorted(worker_of) == sorted(idxs)
+    for s in shards:
+        workers = {worker_of[s * 16 + j] for j in range(8)}
+        assert workers == {_affinity_of(s, 4)}  # whole shard, one worker
+    # with hash collisions the ceil(B/N) rebalance may split ONLY the
+    # overflow (utilization beats affinity there): cap still holds and
+    # non-overflowing shards stay whole
+    mixed = [s * 16 + j for j in range(8) for s in range(8)]
+    mixed_spans = _affinity_spans(mixed, 4, shard_of)
+    loads = {}
+    for s in range(8):
+        loads.setdefault(_affinity_of(s, 4), []).append(s)
+    whole = {i: w for w, offs, sidx in mixed_spans for i, w in
+             zip(sidx, [w] * len(sidx))}
+    for w, ss in loads.items():
+        if len(ss) * 8 <= -(-64 // 4):  # this worker never overflowed
+            for s in ss:
+                assert {whole[s * 16 + j] for j in range(8)} == {w}
+    # and the grouping is BY SHARD, not by index: two samples of one
+    # shard with very different indices share a worker pre-rebalance
+    for s in range(8):
+        assert _affinity_of(shard_of(s * 16), 4) == \
+            _affinity_of(shard_of(s * 16 + 7), 4)
+
+
+def test_feed_stats_records_span_routing(tmp_path):
+    """The routing mode is observable: ``span_routing`` reads "shard"
+    for a dataset exposing shard_of, "index" otherwise, "contiguous"
+    with affinity off — before AND after the lazy pipeline exists."""
+    from dptpu.data.loader import DataLoader
+    from dptpu.data.sampler import ShardedSampler
+
+    class _FakeShardDS:
+        """Minimal dataset surface; never decoded (no epochs run)."""
+
+        def __len__(self):
+            return 32
+
+        def shard_of(self, i):
+            return i // 8
+
+    class _FakeDS:
+        def __len__(self):
+            return 32
+
+    for ds, affinity, expect in (
+        (_FakeShardDS(), True, "shard"),
+        (_FakeDS(), True, "index"),
+        (_FakeShardDS(), False, "contiguous"),
+    ):
+        dl = DataLoader(
+            ds, 8, sampler=ShardedSampler(32, shuffle=False),
+            num_workers=2, workers_mode="process",
+            span_affinity=affinity,
+        )
+        try:
+            assert dl.feed_stats()["span_routing"] == expect
+        finally:
+            dl.close()
+
+
 def test_degrade_to_thread_with_leases_held(monkeypatch):
     """A pool that hangs past its restart budget must degrade to thread
     mode even mid-leased-epoch: the retiring pipeline tolerates the
