@@ -172,7 +172,9 @@ class DataLoader:
         self._pipeline = None  # lazy shm ring (process mode)
         self._prev_cache_counts = (0, 0)  # feed_stats interval baseline
         self._degraded = False  # process pool gave up → thread fallback
-        self._supervision = {"pool_restarts": 0, "span_retries": 0}
+        self._supervision = {"pool_restarts": 0, "span_retries": 0,
+                             "straggler_resplits": 0,
+                             "worker_evictions": 0}
         self._copy_totals = {"bytes_copied": 0, "collects": 0}
         # ring telemetry folded across pipeline rebuilds (same
         # survive-rebuilds discipline as _supervision/_copy_totals)
@@ -481,6 +483,36 @@ class DataLoader:
             return self._pipeline.kill_worker()
         return None
 
+    # -- straggler-control seam (dptpu/resilience/elastic.py) ---------------
+    # All three no-op safely in thread mode / before the lazy pipeline
+    # exists, so the controller may always be armed.
+
+    def worker_latency_observations(self):
+        """Span issue→ack latencies ``[(worker_id, seconds), ...]``
+        accumulated since the last call (process mode only)."""
+        if self._pipeline is not None:
+            return self._pipeline.drain_latency_observations()
+        return []
+
+    def resplit_worker(self, worker_id: int) -> int:
+        """Re-issue a slow worker's pending span tail to healthy workers
+        and route future affinity away from it; returns spans re-issued."""
+        if self._pipeline is not None:
+            return self._pipeline.resplit_worker(worker_id)
+        return 0
+
+    def restore_worker(self, worker_id: int):
+        """Let a recovered worker rejoin the affinity router."""
+        if self._pipeline is not None:
+            self._pipeline.restore_worker(worker_id)
+
+    def evict_worker(self, worker_id: int):
+        """Escalate to the supervisor's eviction policy: kill the worker
+        (the watchdog restart re-enqueues its work); returns the pid."""
+        if self._pipeline is not None:
+            return self._pipeline.evict_worker(worker_id)
+        return None
+
     def _ensure_pipeline(self, slots: int):
         from dptpu.data.shm import ShmBatchPipeline
 
@@ -528,8 +560,7 @@ class DataLoader:
         if self._pipeline is not None:
             for k, v in self._pipeline.supervision_stats().items():
                 restarts[k] += v
-        if restarts["pool_restarts"] or restarts["span_retries"] \
-                or self._degraded:
+        if any(restarts.values()) or self._degraded:
             stats.update(restarts)
         if self._degraded:
             stats["degraded"] = True

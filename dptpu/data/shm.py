@@ -275,6 +275,7 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
                 break
             slot, task_id, offsets, idxs, epoch = task
             try:
+                t_span = time.monotonic()
                 for off, index in zip(offsets, idxs):
                     if fault_plan is not None:
                         fault_plan.worker_decode_hook(worker_id, index)
@@ -290,7 +291,11 @@ def _worker_main(worker_id, dataset, imgs_name, labels_name, slots,
                         _copy_checked(row, img, index)
                         labels[slot, off] = lab
                 hits, misses = (cache.hits, cache.misses) if cache else (0, 0)
-                res_q.put(("done", worker_id, slot, task_id, hits, misses))
+                # the span's own decode wall time rides the ack — the
+                # straggler controller's per-worker speed signal,
+                # unpolluted by queue wait or the parent's drain cadence
+                res_q.put(("done", worker_id, slot, task_id, hits, misses,
+                           time.monotonic() - t_span))
             except BaseException:
                 res_q.put(
                     ("error", worker_id, slot, task_id,
@@ -432,6 +437,16 @@ class ShmBatchPipeline:
         self._quarantine = set()  # freed slots awaiting ghost acks
         self._speculated = set()  # (slot, task_id) already re-issued
         self._straggler_reissues_total = 0
+        # straggler-control seam (dptpu/resilience/elastic.py): every
+        # done ack carries the span's worker-side decode duration —
+        # charged to the worker that DID the decode — drained by the
+        # controller each tick; a re-split routes future affinity AWAY
+        # from a slow worker and the eviction hook feeds the
+        # supervisor's restart policy
+        self._latency_obs = []  # [(acking_worker, span_decode_s), ...]
+        self._routed_away = set()  # workers the affinity router avoids
+        self._resplits_total = 0
+        self._evictions_total = 0
         self._io_wait_s = 0.0  # parent time blocked in collect waits
         self._occ_sum = 0  # ring-occupancy accumulator (sampled at collect)
         self._occ_n = 0
@@ -529,6 +544,27 @@ class ShmBatchPipeline:
             if self.span_affinity
             else _contiguous_spans(batch_indices, self.num_workers)
         )
+        if self._routed_away:
+            # straggler route-away (the affinity seam): spans headed for
+            # a worker the controller re-split divert to the least-
+            # loaded healthy workers — planned loads tracked per span,
+            # so a batch's diverted spans SPREAD instead of all landing
+            # on whoever was idlest at remap time. Affinity resumes
+            # when the controller restores the worker (recovered) or a
+            # pool restart installs a fresh one.
+            healthy = [w for w in range(self.num_workers)
+                       if w not in self._routed_away]
+            if healthy:
+                planned = dict.fromkeys(healthy, 0)
+                remapped = []
+                for wid, offs, idxs in spans:
+                    if wid in self._routed_away:
+                        t = min(healthy, key=lambda k:
+                                self._worker_load[k] + planned[k])
+                        planned[t] += 1
+                        wid = t
+                    remapped.append((wid, offs, idxs))
+                spans = remapped
         for task_id, (wid, offsets, idxs) in enumerate(spans):
             task = (slot, task_id, offsets, idxs, epoch, wid)
             self._pending[slot][task_id] = task
@@ -712,6 +748,73 @@ class ShmBatchPipeline:
         p.join(timeout=5.0)
         return pid
 
+    # -- straggler control seam (dptpu/resilience/elastic.py) ---------------
+
+    def drain_latency_observations(self):
+        """``[(worker_id, span decode seconds), ...]`` since the last
+        drain — the straggler controller's input. Durations are
+        measured INSIDE the worker (stamped on the ack), so the signal
+        reads pure per-worker decode speed, never the parent's drain
+        cadence or queue depth."""
+        obs, self._latency_obs = self._latency_obs, []
+        return obs
+
+    def resplit_worker(self, worker_id: int) -> int:
+        """Controller escalation 1: re-issue worker ``worker_id``'s
+        entire pending span tail to the least-loaded healthy workers NOW
+        (the speculation machinery without its time gate — duplicate
+        acks absorb as ghosts, first-writer-wins keeps bit-identity)
+        and steer future affinity away from it until it is evicted or
+        recovers. Returns the number of spans re-issued."""
+        if not 0 <= worker_id < self.num_workers:
+            raise ValueError(
+                f"resplit_worker({worker_id}): pool has "
+                f"{self.num_workers} workers"
+            )
+        targets = [w for w in range(self.num_workers)
+                   if w != worker_id and w not in self._routed_away]
+        if not targets:
+            return 0  # nobody healthy to take the tail
+        n = 0
+        for slot, spans in self._pending.items():
+            for task_id, task in list(spans.items()):
+                if task[5] != worker_id \
+                        or (slot, task_id) in self._speculated:
+                    continue
+                t = min(targets, key=lambda k: self._worker_load[k])
+                self._speculated.add((slot, task_id))
+                self._extra_issues[slot] += 1
+                self._worker_load[t] += 1
+                self._straggler_reissues_total += 1
+                self._task_qs[t].put(task[:5])
+                n += 1
+        self._routed_away.add(worker_id)
+        self._resplits_total += 1
+        return n
+
+    def restore_worker(self, worker_id: int):
+        """Controller de-escalation: a re-split worker whose fresh
+        observations read healthy again rejoins the affinity router."""
+        self._routed_away.discard(worker_id)
+
+    def evict_worker(self, worker_id: int) -> Optional[int]:
+        """Controller escalation 2 — the supervisor's eviction policy:
+        SIGKILL the worker; the watchdog's pool restart re-enqueues its
+        unacked spans (the proven worker_kill recovery path). The dead
+        worker stays routed-away until the restart actually installs
+        its replacement (``_restart_pool`` clears the set) — routing
+        spans at a corpse's queue would stall every batch behind the
+        speculation window."""
+        if not 0 <= worker_id < len(self._procs):
+            return None
+        p = self._procs[worker_id]
+        pid = p.pid if p.is_alive() else None
+        if pid is not None:
+            p.kill()
+            p.join(timeout=5.0)
+        self._evictions_total += 1
+        return pid
+
     # -- supervision --------------------------------------------------------
 
     def _next_result(self, requeue: bool = True, tick=None):
@@ -819,6 +922,8 @@ class ShmBatchPipeline:
             self._free.extend(sorted(self._quarantine))
             self._quarantine.clear()
         self._worker_load = [0] * self.num_workers
+        # the whole pool is fresh: straggler verdicts start over
+        self._routed_away.clear()
         if requeue:
             for spans in self._pending.values():
                 for task in spans.values():
@@ -851,6 +956,13 @@ class ShmBatchPipeline:
         if kind == "done":
             self._consec_failures = 0  # the pool is making progress
             self._worker_cache[worker_id] = (msg[4], msg[5])
+            if len(msg) > 6:
+                # the span's worker-side decode duration, charged to
+                # whichever worker actually decoded it (ghost twins
+                # included — their decode speed is real signal too)
+                self._latency_obs.append((worker_id, float(msg[6])))
+                if len(self._latency_obs) > 4096:
+                    del self._latency_obs[:2048]
             if self._pending[slot].pop(task_id, None) is None:
                 self._ghost_ack(slot)
                 return
@@ -916,10 +1028,12 @@ class ShmBatchPipeline:
         return stats
 
     def supervision_stats(self) -> dict:
-        """Watchdog counters for feed telemetry."""
+        """Watchdog + straggler-control counters for feed telemetry."""
         return {
             "pool_restarts": self._restarts_total,
             "span_retries": self._span_retries_total,
+            "straggler_resplits": self._resplits_total,
+            "worker_evictions": self._evictions_total,
         }
 
     def copy_stats(self) -> dict:

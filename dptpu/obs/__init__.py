@@ -55,10 +55,12 @@ from dptpu.obs.metrics import (
 )
 from dptpu.obs.report import (
     SPAN_CATEGORY,
+    P2Quantile,
     attribute_epoch,
     attribute_spans,
     exclusive_durations,
     format_report,
+    merge_pod_timeline,
 )
 from dptpu.obs.trace import (
     NullTracer,
@@ -74,7 +76,7 @@ __all__ = [
     "TensorBoardSink", "JsonlSink", "ConsoleSink",
     "ProfileTrigger",
     "attribute_epoch", "attribute_spans", "exclusive_durations",
-    "format_report", "SPAN_CATEGORY",
+    "format_report", "SPAN_CATEGORY", "P2Quantile", "merge_pod_timeline",
     "get_tracer", "set_tracer", "get_registry", "set_registry",
     "reset", "obs_knobs",
 ]

@@ -28,6 +28,9 @@ p50/p90/max step time plus an anomalous-step log (steps slower than
 
 from __future__ import annotations
 
+import glob
+import json
+import os
 from typing import Dict, List, Optional
 
 from dptpu.obs.metrics import _quantile
@@ -155,6 +158,223 @@ def attribute_epoch(spans: List[dict], wall_s: float,
         "anomalous_steps": anomalies,
         "span_count": len(spans),
     }
+
+
+class P2Quantile:
+    """Streaming quantile estimate via the P² algorithm (Jain &
+    Chlamtac, CACM 1985): five markers, O(1) memory and O(1) per
+    observation — the right shape for a pod timeline that may span a
+    90-epoch run's worth of step spans. Exact (sorted interpolation)
+    below five observations; the classic parabolic/linear marker update
+    beyond. ``value()`` is the current estimate of quantile ``q``."""
+
+    __slots__ = ("q", "count", "_heights", "_pos", "_want", "_inc")
+
+    def __init__(self, q: float = 0.5):
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"P2Quantile q={q} must be in (0, 1)")
+        self.q = q
+        self.count = 0
+        self._heights: List[float] = []  # marker heights q0..q4
+        self._pos = [1.0, 2.0, 3.0, 4.0, 5.0]  # actual positions n_i
+        self._want = [1.0, 1.0 + 2 * q, 1.0 + 4 * q, 3.0 + 2 * q, 5.0]
+        self._inc = [0.0, q / 2.0, q, (1.0 + q) / 2.0, 1.0]
+
+    def add(self, x: float):
+        x = float(x)
+        self.count += 1
+        h = self._heights
+        if self.count <= 5:
+            h.append(x)
+            h.sort()
+            return
+        # locate the cell; extremes extend the end markers
+        if x < h[0]:
+            h[0] = x
+            k = 0
+        elif x >= h[4]:
+            h[4] = x
+            k = 3
+        else:
+            k = 0
+            while k < 3 and x >= h[k + 1]:
+                k += 1
+        for i in range(k + 1, 5):
+            self._pos[i] += 1.0
+        for i in range(5):
+            self._want[i] += self._inc[i]
+        # adjust the three interior markers toward their desired spots
+        for i in (1, 2, 3):
+            d = self._want[i] - self._pos[i]
+            if (d >= 1.0 and self._pos[i + 1] - self._pos[i] > 1.0) or \
+                    (d <= -1.0 and self._pos[i - 1] - self._pos[i] < -1.0):
+                s = 1.0 if d >= 1.0 else -1.0
+                # parabolic (P²) estimate; fall back to linear if it
+                # would break marker monotonicity
+                nl, ni, nr = self._pos[i - 1], self._pos[i], self._pos[i + 1]
+                hp = h[i] + s / (nr - nl) * (
+                    (ni - nl + s) * (h[i + 1] - h[i]) / (nr - ni)
+                    + (nr - ni - s) * (h[i] - h[i - 1]) / (ni - nl)
+                )
+                if not h[i - 1] < hp < h[i + 1]:
+                    j = i + int(s)
+                    hp = h[i] + s * (h[j] - h[i]) / (self._pos[j] - ni)
+                h[i] = hp
+                self._pos[i] += s
+
+    def value(self) -> float:
+        if self.count == 0:
+            return 0.0
+        if self.count <= 5:
+            return _quantile(sorted(self._heights), self.q)
+        return self._heights[2]
+
+
+# merged-timeline temp files still on disk (conftest leak guard: every
+# merge must either complete its atomic rename or unlink its temp)
+_LIVE_MERGE_TMPS: set = set()
+
+
+def live_merge_tmp_count() -> int:
+    return len(_LIVE_MERGE_TMPS)
+
+
+def merge_pod_timeline(directory: str, out_path: Optional[str] = None,
+                       window_s: float = 60.0,
+                       straggler_factor: float = 1.5) -> dict:
+    """Chief-side collector: merge every per-host ``obs-<host>.jsonl``
+    under ``directory`` into ONE pod timeline (ROADMAP item 3c).
+
+    Streaming pass — constant memory per host via :class:`P2Quantile`,
+    so a week-long pod log merges without loading it: per-host p50/p90
+    for every span category, per-host ``iter`` (step-time) quantiles
+    bucketed into ``window_s`` wall-clock windows ("what changed at
+    14:07" = the window whose p50 jumped), the epoch reports each host
+    logged, and a straggler verdict (hosts whose step p50 exceeds
+    ``straggler_factor`` × the pod-wide p50 — only meaningful with >= 2
+    hosts; a 1-host pod reports an empty list).
+
+    ``out_path`` (optional) writes the merged timeline atomically
+    (tempfile + rename in the target directory; the temp is tracked so
+    the test suite's leak guard can prove none is ever left behind).
+    """
+    hosts: Dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(directory, "obs-*.jsonl"))):
+        host = os.path.basename(path)[len("obs-"):-len(".jsonl")]
+        h = hosts.setdefault(host, {
+            "spans": {},  # name -> {count, p50 P2, p90 P2}
+            "iter_p50": P2Quantile(0.5), "iter_p90": P2Quantile(0.9),
+            "iter_count": 0,
+            "windows": {},  # int(ts // window_s) -> {count, p50 P2}
+            "epochs": [],
+            "events": 0,
+            "bad_lines": 0,
+        })
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    h["bad_lines"] += 1
+                    continue
+                kind = rec.get("kind")
+                if kind == "span":
+                    name, dur = rec.get("name"), rec.get("dur_s", 0.0)
+                    s = h["spans"].setdefault(
+                        name,
+                        {"count": 0, "p50": P2Quantile(0.5),
+                         "p90": P2Quantile(0.9)},
+                    )
+                    s["count"] += 1
+                    s["p50"].add(dur)
+                    s["p90"].add(dur)
+                    if name == "iter":
+                        h["iter_p50"].add(dur)
+                        h["iter_p90"].add(dur)
+                        h["iter_count"] += 1
+                        w = h["windows"].setdefault(
+                            int(rec.get("ts", 0.0) // window_s),
+                            {"count": 0, "p50": P2Quantile(0.5)},
+                        )
+                        w["count"] += 1
+                        w["p50"].add(dur)
+                elif kind == "epoch_report":
+                    h["epochs"].append({
+                        k: rec[k] for k in
+                        ("epoch", "wall_s", "data_wait_s", "device_s",
+                         "step_p50_s")
+                        if k in rec
+                    })
+                else:
+                    h["events"] += 1
+    pod_p50 = P2Quantile(0.5)
+    out_hosts = {}
+    for host, h in hosts.items():
+        windows = []
+        prev = None
+        for wk in sorted(h["windows"]):
+            w = h["windows"][wk]
+            p50 = round(w["p50"].value(), 6)
+            windows.append({
+                "t0": wk * window_s,
+                "steps": w["count"],
+                "step_p50_s": p50,
+                # the "what changed at 14:07" hook: this window's p50
+                # relative to the previous window's
+                "vs_prev": round(p50 / prev, 3) if prev else 1.0,
+            })
+            prev = p50 or prev
+        out_hosts[host] = {
+            "steps": h["iter_count"],
+            "step_p50_s": round(h["iter_p50"].value(), 6),
+            "step_p90_s": round(h["iter_p90"].value(), 6),
+            "spans": {
+                name: {"count": s["count"],
+                       "p50_s": round(s["p50"].value(), 6),
+                       "p90_s": round(s["p90"].value(), 6)}
+                for name, s in sorted(h["spans"].items())
+            },
+            "windows": windows,
+            "epochs": h["epochs"],
+            "bad_lines": h["bad_lines"],
+        }
+        if h["iter_count"]:
+            pod_p50.add(h["iter_p50"].value())
+    pod = round(pod_p50.value(), 6)
+    stragglers = []
+    if len([h for h in out_hosts.values() if h["steps"]]) >= 2 and pod > 0:
+        stragglers = sorted(
+            host for host, h in out_hosts.items()
+            if h["steps"] and h["step_p50_s"] > straggler_factor * pod
+        )
+    timeline = {
+        "directory": directory,
+        "window_s": window_s,
+        "hosts": out_hosts,
+        "pod_step_p50_s": pod,
+        "straggler_factor": straggler_factor,
+        "stragglers": stragglers,
+    }
+    if out_path is not None:
+        tmp = out_path + ".tmp"
+        _LIVE_MERGE_TMPS.add(tmp)
+        try:
+            with open(tmp, "w") as f:
+                json.dump(timeline, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, out_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        finally:
+            _LIVE_MERGE_TMPS.discard(tmp)
+    return timeline
 
 
 def format_report(report: dict, epoch: Optional[int] = None) -> str:

@@ -97,6 +97,30 @@ def hierarchy_knobs(cfg=None) -> tuple:
     return int(slices), dcn_dtype
 
 
+def elastic_slices_check(world_size: int, slices: int):
+    """Elastic-resume × ``--slices`` composition (ROADMAP item 3,
+    elastic satellite): a SHRUNK world must still factor into the
+    configured slice count, or the hierarchical mesh cannot build. The
+    generic ``make_hierarchical_mesh`` divisibility error names only
+    the mismatch; an elastic restart deserves the two actionable
+    fallbacks, so this check runs FIRST on the elastic path and its
+    message is locked by tests (tests/test_elastic.py).
+    """
+    if slices > 1 and world_size % slices != 0:
+        divisors = [s for s in range(2, world_size + 1)
+                    if world_size % s == 0]
+        example = f"DPTPU_SLICES={divisors[0]}" if divisors \
+            else "no slice count > 1 divides it"
+        raise ValueError(
+            f"elastic resume: the shrunk world of {world_size} devices "
+            f"does not divide into DPTPU_SLICES/--slices={slices} "
+            f"slices, so the hierarchical mesh cannot factor. Fix one "
+            f"knob: drop slices (unset DPTPU_SLICES to run the flat "
+            f"single-level data mesh) or pick a slice count that "
+            f"divides {world_size} (e.g. {example})."
+        )
+
+
 def is_hierarchical(mesh: Optional[Mesh]) -> bool:
     return mesh is not None and SLICE_AXIS in mesh.axis_names
 

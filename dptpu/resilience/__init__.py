@@ -1,6 +1,16 @@
-"""Resilience layer: survive preemption, worker failure, and torn writes.
+"""Resilience layer: survive preemption, worker failure, torn writes —
+and, since the elastic-lifecycle work, a pod that shrinks, loses hosts,
+or drags a straggler.
 
-Three pillars (ISSUE 2 / ROADMAP fault-tolerance):
+Pillars (ISSUE 2 + ROADMAP item 3):
+
+* **elastic resume** — :mod:`dptpu.resilience.elastic`: re-map a saved
+  mid-epoch position onto a new ``(world_size, global_batch, accum)``
+  (``DPTPU_ELASTIC=1``), replaying exactly the untrained remainder;
+  plus the live straggler controller (re-split → evict → elastic);
+* **quorum saves** — :mod:`dptpu.resilience.quorum`: pod-consistent
+  mid-epoch checkpoints when only one host catches the SIGTERM, via a
+  barrier-with-deadline over the coordination store;
 
 * **preemption-safe mid-epoch checkpointing** — rotated, CRC-sealed step
   checkpoints (:mod:`dptpu.resilience.checkpoint`) whose ``(epoch,
@@ -27,6 +37,17 @@ _EXPORTS = {
     "find_resumable": "dptpu.resilience.checkpoint",
     "step_checkpoint_name": "dptpu.resilience.checkpoint",
     "verify_checkpoint": "dptpu.resilience.checkpoint",
+    # elastic pod lifecycle (ROADMAP item 3): geometry re-mapping,
+    # straggler control, and the quorum save protocol
+    "ElasticRemap": "dptpu.resilience.elastic",
+    "StragglerController": "dptpu.resilience.elastic",
+    "elastic_knobs": "dptpu.resilience.elastic",
+    "remainder_indices": "dptpu.resilience.elastic",
+    "remap_resume_position": "dptpu.resilience.elastic",
+    "FileKVStore": "dptpu.resilience.quorum",
+    "QuorumCoordinator": "dptpu.resilience.quorum",
+    "QuorumSession": "dptpu.resilience.quorum",
+    "make_coordinator": "dptpu.resilience.quorum",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -1,0 +1,376 @@
+"""Elastic pod lifecycle: shrink/grow resume + straggler-driven control.
+
+**Elastic resume** (ROADMAP item 3a). PR 10 stamped every checkpoint
+with its ``(world_size, global_batch, accum)`` geometry and made a
+mismatched ``--resume`` fail fast; this module makes it RESUME. The key
+property is the sampler's interleaved shard assignment
+(``dptpu/data/sampler.py``): shard ``i`` of ``N`` takes
+``order[i::N]`` of the epoch's ``(seed, epoch)``-pure permutation, so
+after ``k`` steps — every host having consumed ``k × host_batch``
+samples — the UNION of visited indices is exactly
+``order[: k × global_batch]``, for ANY factoring of the global batch
+into hosts and devices. The visited prefix is geometry-independent.
+
+A shrink (or grow) therefore reduces to arithmetic: the saved position
+is ``consumed = step_in_epoch × global_batch_saved`` samples into the
+epoch order, and the new geometry resumes at
+``consumed / global_batch_new`` — a plain ``start_batch`` replay on the
+new sampler — visiting exactly the untrained remainder
+``order[consumed:]``. The only structural requirement is that
+``consumed`` is a whole number of new-geometry steps; anything else
+fails fast naming a dividing batch size (the locked knob contract).
+
+Exactness contract (FAULTBENCH ``shrink_resume`` + tests): the visited
+-index set over the resumed epoch is the set difference — Δ = ∅ — and
+the elastic replay itself is deterministic (two identical elastic
+resumes are bit-identical in params and loss). The TRAJECTORY is not
+bit-identical to the old-geometry run — gradients now average over a
+different global batch, which is the point of shrinking — so the LR is
+rescaled per the linear-scaling rule and the delta is logged loudly.
+
+**Straggler-driven control** (ROADMAP item 3c). The chief-side
+collector (``dptpu/obs/report.py merge_pod_timeline``) answers "which
+host/worker is slow" retroactively; :class:`StragglerController` closes
+the loop LIVE: it consumes the shm pipeline's per-worker span-ack
+latencies (streaming P² quantiles per worker), and when one worker's
+p50 stays above ``DPTPU_STRAGGLER_FACTOR`` × its healthiest peer's for
+``DPTPU_STRAGGLER_PERSIST`` consecutive ticks it escalates through the
+existing seams:
+
+1. **re-split** — the worker's pending span tail re-issues to the
+   least-loaded healthy workers (the speculation machinery;
+   ``straggler_reissues`` counts it) and the affinity router steers new
+   spans away from it; the worker enters PROBATION on a fresh verdict
+   window (cumulative history would keep convicting a worker whose
+   transient slowdown already passed), judged only on fresh evidence
+   (its draining backlog keeps acking, so a sick worker keeps
+   convicting itself while a drained one neither escalates nor
+   recovers on stale numbers);
+2. **evict or restore** — fresh evidence still slow for another
+   ``persist`` verdicts triggers the shm supervisor's eviction policy
+   (the worker is killed; the pool restart re-enqueues its work —
+   bit-identity preserved by the same first-writer-wins contract every
+   chaos scenario already locks), while a healthy fresh verdict
+   restores it to the affinity router;
+3. **elastic** — a HOST gone for good (quorum heartbeats silent, or
+   the ``host_lost`` fault) stops the run with a sync save; the
+   operator restarts on the smaller world with ``DPTPU_ELASTIC=1``.
+
+This module is trainer-side (imported lazily via dptpu.resilience);
+the hot-path helpers stay numpy/stdlib so knob parsing never drags JAX
+into tools that only want the arithmetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from dptpu.envknob import env_bool, env_float, env_int
+
+
+def elastic_knobs(environ=None) -> dict:
+    """The elastic-lifecycle env knobs, under the locked fail-fast
+    contract (every explicit-but-invalid value raises, pre-compile):
+
+    * ``DPTPU_ELASTIC`` — opt in to geometry re-mapping on ``--resume``
+      (default off: a surprise geometry change should still fail fast);
+    * ``DPTPU_QUORUM_DEADLINE_S`` — bound on every quorum wait
+      (``dptpu/resilience/quorum.py``; > 0, default 30);
+    * ``DPTPU_STRAGGLER_FACTOR`` — arm the straggler controller: a
+      worker is slow when its span p50 exceeds this multiple of its
+      healthiest peer's (> 1; unset = controller off);
+    * ``DPTPU_STRAGGLER_PERSIST`` — consecutive slow verdicts before
+      the re-split fires (>= 1, default 2; eviction follows after the
+      same count again).
+    """
+    from dptpu.resilience.quorum import quorum_deadline_knob
+
+    elastic = env_bool("DPTPU_ELASTIC", False, environ)
+    deadline = quorum_deadline_knob(environ)
+    factor = env_float("DPTPU_STRAGGLER_FACTOR", None, environ)
+    if factor is not None and factor <= 1.0:
+        raise ValueError(
+            f"DPTPU_STRAGGLER_FACTOR={factor} must be > 1 (a worker is "
+            f"a straggler when its span p50 exceeds factor x its "
+            f"healthiest peer's; e.g. DPTPU_STRAGGLER_FACTOR=2.5)"
+        )
+    persist = env_int("DPTPU_STRAGGLER_PERSIST", 2, environ)
+    if persist < 1:
+        raise ValueError(
+            f"DPTPU_STRAGGLER_PERSIST={persist} must be >= 1 "
+            f"consecutive slow verdicts before the re-split fires"
+        )
+    return {
+        "elastic": bool(elastic),
+        "quorum_deadline_s": deadline,
+        "straggler_factor": factor,
+        "straggler_persist": int(persist),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticRemap:
+    """The result of re-mapping a saved mid-epoch position onto a new
+    geometry — everything fit() needs to wire the replay and log it."""
+
+    saved_geometry: tuple  # (world, global_batch, accum) that saved
+    new_geometry: tuple  # this run's tuple
+    consumed: int  # global samples of the epoch already trained
+    new_step: int  # start_batch on the new geometry
+    accum_changed: bool  # virtual-replica streams differ (loud note)
+
+
+def remap_resume_position(saved_geometry: Sequence[int],
+                          new_geometry: Sequence[int],
+                          step_in_epoch: int,
+                          slices: int = 1,
+                          num_examples: Optional[int] = None
+                          ) -> ElasticRemap:
+    """Re-map ``(epoch, step_in_epoch)`` saved under ``saved_geometry``
+    onto ``new_geometry`` (see module docstring for why this is exact).
+
+    Raises (fail fast, actionable — the locked contract):
+
+    * when the shrunk world does not divide ``slices``
+      (``dptpu/parallel/hierarchy.py elastic_slices_check`` — the
+      message names the knob and both fallbacks);
+    * when the consumed prefix is not a whole number of new-geometry
+      steps (names a dividing global batch).
+    """
+    saved = tuple(int(g) for g in saved_geometry)
+    new = tuple(int(g) for g in new_geometry)
+    if len(saved) != 3 or len(new) != 3:
+        raise ValueError(
+            f"geometry tuples must be (world_size, global_batch, "
+            f"accum); got saved={saved} new={new}"
+        )
+    if saved[1] <= 0 or new[1] <= 0:
+        raise ValueError(
+            f"elastic resume needs positive global batches; got "
+            f"saved={saved} new={new}"
+        )
+    from dptpu.parallel.hierarchy import elastic_slices_check
+
+    elastic_slices_check(new[0], slices)
+    consumed = int(step_in_epoch) * saved[1]
+    if num_examples is not None and consumed > num_examples:
+        # the saved run was deep into the sampler's wrap-around padding
+        # (dataset not divisible by the old host count): the padded
+        # prefix depends on the OLD shard count, so the visited set is
+        # no longer geometry-independent and the exact remap is void
+        raise ValueError(
+            f"elastic resume: the saved position ({consumed} samples) "
+            f"is past the dataset's {num_examples} samples — the run "
+            f"was inside the sampler's wrap-around padding, whose "
+            f"order depends on the saved host count, so an exact "
+            f"remainder replay is impossible. Pass --start-epoch to "
+            f"restart from the next epoch boundary."
+        )
+    if consumed % new[1] != 0:
+        divisors = sorted(
+            b for b in range(1, consumed + 1) if consumed % b == 0
+        )
+        close = min(divisors, key=lambda b: abs(b - new[1]))
+        raise ValueError(
+            f"elastic resume: the saved position ({step_in_epoch} steps "
+            f"x global batch {saved[1]} = {consumed} samples consumed) "
+            f"is not a whole number of steps at the new global batch "
+            f"{new[1]} — the remainder replay would split a batch. "
+            f"Pick a global batch that divides {consumed} (e.g. "
+            f"{close}), or resume on the saved geometry."
+        )
+    return ElasticRemap(
+        saved_geometry=saved,
+        new_geometry=new,
+        consumed=consumed,
+        new_step=consumed // new[1],
+        accum_changed=saved[2] != new[2],
+    )
+
+
+def remainder_indices(num_examples: int, seed: int, epoch: int,
+                      consumed: int, global_batch: int,
+                      num_shards: int = 1):
+    """The untrained remainder an elastic resume will visit, computed
+    from the SAME pure sampler math the loaders run — the Δ = ∅ oracle
+    FAULTBENCH and the tests gate against. Returns the (sorted) global
+    sample indices of epoch ``epoch`` from position ``consumed``
+    through the last whole ``global_batch`` (drop_last discipline),
+    unioned across all ``num_shards`` hosts."""
+    import numpy as np
+
+    from dptpu.data.sampler import ShardedSampler
+
+    visited = []
+    per_host = global_batch // num_shards
+    for shard in range(num_shards):
+        s = ShardedSampler(num_examples, num_shards=num_shards,
+                           shard_index=shard, shuffle=True, seed=seed)
+        idx = s.indices(epoch)
+        start = consumed // num_shards
+        nb = (len(idx) - start) // per_host
+        visited.append(idx[start:start + nb * per_host])
+    return np.sort(np.concatenate(visited)) if visited else \
+        np.empty((0,), np.int64)
+
+
+# --------------------------------------------------------------- control ----
+
+
+class StragglerController:
+    """Chief-side live feedback loop over the feed's worker pool (see
+    module docstring, item 3c). ``tick()`` rides fit's post-step hook;
+    the loader seam (``DataLoader.worker_latency_observations`` /
+    ``resplit_worker`` / ``evict_worker``) no-ops in thread mode, so the
+    controller is always safe to arm."""
+
+    def __init__(self, loader, factor: float, persist: int = 2,
+                 min_obs: int = 4, on_event=None):
+        if factor <= 1.0:
+            raise ValueError(
+                f"straggler factor={factor} must be > 1"
+            )
+        if persist < 1:
+            raise ValueError(f"straggler persist={persist} must be >= 1")
+        self.loader = loader
+        self.factor = float(factor)
+        self.persist = int(persist)
+        self.min_obs = int(min_obs)
+        self.on_event = on_event  # callable(kind, payload) — obs log
+        self._p50 = {}  # worker -> P2Quantile (reset at each escalation)
+        self._count = {}
+        self._strikes = {}
+        # workers in the post-re-split probation window: their verdict
+        # restarts on a FRESH estimator (cumulative history would keep
+        # convicting a worker whose transient slowdown already passed),
+        # and the next persist slow verdicts escalate to eviction while
+        # a healthy verdict restores them to the affinity router. A
+        # suspect whose backlog drains before the verdict resolves
+        # (routed away = no new spans = no new evidence) is PROBED
+        # after ``probe_after`` evidence-free ticks: re-admitted to the
+        # router with the verdict window still armed, so its next spans
+        # decide — without the probe, a transiently-slow worker would
+        # stay benched forever (neither restorable nor evictable).
+        self._suspect = set()
+        self._stale_ticks = {}  # suspect -> consecutive evidence-free ticks
+        self.probe_after = max(2 * self.persist, 4)
+        self.resplits = 0
+        self.evictions = 0
+        self.events = []
+
+    def _emit(self, kind: str, payload: dict):
+        self.events.append({"kind": kind, **payload})
+        if self.on_event is not None:
+            try:
+                self.on_event(kind, payload)
+            except Exception:
+                pass
+
+    def _reset_verdict(self, w):
+        from dptpu.obs.report import P2Quantile
+
+        self._p50[w] = P2Quantile(0.5)
+        self._count[w] = 0
+        self._strikes[w] = 0
+
+    def tick(self):
+        obs = self.loader.worker_latency_observations()
+        fresh = {}
+        for wid, lat in obs:
+            if wid not in self._p50:
+                self._reset_verdict(wid)
+            self._p50[wid].add(lat)
+            self._count[wid] += 1
+            fresh[wid] = fresh.get(wid, 0) + 1
+        # probation probes run before the ready gate: a drained suspect
+        # is exactly the worker with too few fresh observations to ever
+        # BE ready again on its own
+        for w in sorted(self._suspect):
+            if fresh.get(w):
+                self._stale_ticks[w] = 0
+                continue
+            self._stale_ticks[w] = self._stale_ticks.get(w, 0) + 1
+            if self._stale_ticks[w] >= self.probe_after:
+                self._stale_ticks[w] = 0
+                self.loader.restore_worker(w)  # routing only: verdict
+                self._emit("straggler_probe", {"worker": w})  # stays armed
+        ready = {w for w, c in self._count.items() if c >= self.min_obs}
+        if len(ready) < 2:
+            return  # slowness is relative: need a peer to compare with
+        p50s = {w: self._p50[w].value() for w in ready}
+        floor = min(p50s.values())
+        if floor <= 0:
+            return
+        for w in sorted(ready):
+            if not fresh.get(w):
+                # no fresh evidence this tick: the verdict FREEZES. A
+                # routed-away worker still acks its draining backlog,
+                # so a genuinely sick worker keeps producing evidence
+                # toward eviction; a drained one neither escalates nor
+                # silently recovers on stale numbers.
+                continue
+            slow = p50s[w] > self.factor * floor
+            if w in self._suspect:
+                if not slow:
+                    # probation passed on fresh evidence: rejoin the
+                    # affinity router, verdict back to normal
+                    self._suspect.discard(w)
+                    self._stale_ticks.pop(w, None)
+                    self._strikes[w] = 0
+                    self.loader.restore_worker(w)
+                    self._emit("straggler_restore", {
+                        "worker": w, "p50_s": round(p50s[w], 4),
+                    })
+                    continue
+                self._strikes[w] += 1
+                if self._strikes[w] >= self.persist:
+                    # escalation 2: the shm supervisor's eviction
+                    # policy — kill the worker; the pool restart
+                    # re-enqueues its work and clears the route-away
+                    pid = self.loader.evict_worker(w)
+                    self.evictions += 1
+                    self._emit("straggler_evict", {
+                        "worker": w, "pid": pid,
+                        "p50_s": round(p50s[w], 4),
+                    })
+                    self._suspect.discard(w)
+                    self._stale_ticks.pop(w, None)
+                    self._reset_verdict(w)  # the replacement's slate
+                continue
+            if not slow:
+                self._strikes[w] = 0
+                continue
+            self._strikes[w] += 1
+            if self._strikes[w] >= self.persist:
+                # escalation 1: re-split the span tail + route away,
+                # then judge the eviction question on a FRESH window —
+                # the post-re-split acks alone decide whether this
+                # worker is sick or merely had a bad moment
+                n = self.loader.resplit_worker(w)
+                self.resplits += 1
+                self._emit("straggler_resplit", {
+                    "worker": w, "p50_s": round(p50s[w], 4),
+                    "healthy_p50_s": round(floor, 4),
+                    "reissued_spans": n,
+                })
+                self._suspect.add(w)
+                self._stale_ticks[w] = 0
+                self._reset_verdict(w)
+
+    def stats(self) -> dict:
+        return {
+            "resplits": self.resplits,
+            "evictions": self.evictions,
+            "workers_observed": len(self._count),
+            "events": list(self.events),
+        }
+
+
+__all__ = [
+    "ElasticRemap",
+    "StragglerController",
+    "elastic_knobs",
+    "remainder_indices",
+    "remap_resume_position",
+]

@@ -19,6 +19,27 @@ naturally):
   with probability F (per-worker deterministic RNG seeded from
   ``DPTPU_FAULT_SEED`` + worker id, so a retry of the same span draws a
   fresh outcome — a *transient* fault). Exercises span retries.
+* ``sigterm_one_host@step=N`` — after step N, a preemption notice
+  reaches this pod through the QUORUM coordinator as if ANOTHER host
+  had caught the SIGTERM (this process receives no signal at all): the
+  run must learn of it from the coordination store on its next tick,
+  agree on a pod-consistent stop step, and save. Without a coordinator
+  (no DPTPU_QUORUM_DIR, single process, no jax.distributed store) it
+  degenerates to a plain local SIGTERM — exactly the PreemptionGuard
+  path.
+* ``host_lost@step=N`` — after step N, declare this pod's host set
+  PERMANENTLY degraded (the "gone for good" verdict the chief's
+  heartbeat monitor would reach): the trainer saves synchronously at
+  the current position, marks the run ``host_lost`` and exits cleanly
+  so the operator can restart on the smaller world with
+  ``DPTPU_ELASTIC=1`` (the shrink-resume path).
+* ``slow_host:factor=F[@step=K][@worker=W]`` — worker W (default 0)
+  becomes a PERSISTENT straggler: every sample decode from its K-th
+  (default 1st) onward sleeps ``F x 20 ms`` (``factor`` > 1; ``step``
+  counts THAT worker's decodes — worker processes have no view of
+  optimizer steps). Identical bytes, just late: drives the straggler
+  controller's detect → re-split → evict escalation without ever
+  touching bit-identity.
 * ``worker_hang@index=K`` — a data worker decoding sample index K sleeps
   effectively forever. Deterministic (every retry hangs again), so it
   drives the watchdog all the way to pool-restart exhaustion and the
@@ -50,8 +71,10 @@ from typing import Callable, Optional
 
 from dptpu.envknob import env_int
 
-_KINDS = ("sigterm", "worker_kill", "ckpt_truncate", "io_error", "worker_hang")
+_KINDS = ("sigterm", "worker_kill", "ckpt_truncate", "io_error",
+          "worker_hang", "sigterm_one_host", "host_lost", "slow_host")
 _HANG_SECONDS = 3600.0
+_SLOW_BASE_S = 0.02  # slow_host: seconds of sleep per unit of factor
 
 
 @dataclasses.dataclass
@@ -62,7 +85,8 @@ class _Fault:
     index: Optional[int] = None
     p: float = 0.0
     seconds: Optional[float] = None  # worker_hang: bounded straggler sleep
-    worker: Optional[int] = None  # worker_hang: only this worker id stalls
+    worker: Optional[int] = None  # worker_hang/slow_host: worker id
+    factor: Optional[float] = None  # slow_host: slowdown multiple (> 1)
     fired: bool = False
 
 
@@ -99,12 +123,16 @@ def _parse_one(spec: str) -> _Fault:
                     raise ValueError
             elif key == "worker":
                 f.worker = int(val)
+            elif key == "factor":
+                f.factor = float(val)
+                if f.factor <= 1.0:
+                    raise ValueError
             else:
                 raise KeyError
         except KeyError:
             raise ValueError(
                 f"DPTPU_FAULT modifier key {key!r} in {spec!r} unknown "
-                f"(accepted: step, save, index, p, s, worker)"
+                f"(accepted: step, save, index, p, s, worker, factor)"
             ) from None
         except ValueError:
             raise ValueError(
@@ -112,12 +140,18 @@ def _parse_one(spec: str) -> _Fault:
                 f"valid value"
             ) from None
     # arm-time validation so a typo'd plan fails before training starts
-    if f.kind in ("sigterm", "worker_kill") and f.step is None:
+    if f.kind in ("sigterm", "worker_kill", "sigterm_one_host",
+                  "host_lost") and f.step is None:
         raise ValueError(f"DPTPU_FAULT {spec!r} needs @step=N")
     if f.kind == "worker_hang" and f.index is None:
         raise ValueError(f"DPTPU_FAULT {spec!r} needs @index=K")
     if f.kind == "io_error" and not f.p:
         raise ValueError(f"DPTPU_FAULT {spec!r} needs :p=F with F > 0")
+    if f.kind == "slow_host" and f.factor is None:
+        raise ValueError(
+            f"DPTPU_FAULT {spec!r} needs :factor=F with F > 1 (the "
+            f"straggler's slowdown multiple, e.g. slow_host:factor=5)"
+        )
     return f
 
 
@@ -138,8 +172,11 @@ class FaultPlan:
         self._steps_done = 0
         self._saves_done = 0
         self._kill_worker_cb: Optional[Callable] = None
+        self._quorum_cb: Optional[Callable] = None
+        self._host_lost_cb: Optional[Callable] = None
         self._worker_rng: Optional[random.Random] = None
         self._store_rng: Optional[random.Random] = None
+        self._slow_decodes = 0  # slow_host: this worker's decode count
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["FaultPlan"]:
@@ -154,6 +191,21 @@ class FaultPlan:
         SIGKILLs one live data worker (e.g. DataLoader.kill_one_worker)."""
         self._kill_worker_cb = cb
 
+    def bind_quorum_request(self, cb: Callable):
+        """Wire ``sigterm_one_host`` to the quorum session's remote-
+        request hook (dptpu/resilience/quorum.py): the fault then models
+        a preemption notice arriving from ANOTHER host through the
+        coordination store. Unbound (no coordinator), the fault
+        degenerates to a plain local SIGTERM."""
+        self._quorum_cb = cb
+
+    def bind_host_lost(self, cb: Callable):
+        """Wire ``host_lost`` to the trainer's gone-for-good handler:
+        sync save at the current position, mark the run, exit cleanly
+        for an elastic restart. Unbound, it degenerates to SIGTERM
+        (save-and-exit is still the right shape)."""
+        self._host_lost_cb = cb
+
     # -- trainer-side hooks -------------------------------------------------
 
     def on_step(self):
@@ -165,6 +217,20 @@ class FaultPlan:
             if f.kind == "sigterm":
                 f.fired = True
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "sigterm_one_host":
+                f.fired = True
+                if self._quorum_cb is not None:
+                    self._quorum_cb()
+                else:
+                    # no coordinator to carry the remote notice:
+                    # degenerate to the PreemptionGuard path
+                    os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "host_lost":
+                f.fired = True
+                if self._host_lost_cb is not None:
+                    self._host_lost_cb()
+                else:
+                    os.kill(os.getpid(), signal.SIGTERM)
             elif f.kind == "worker_kill":
                 f.fired = True
                 if self._kill_worker_cb is not None:
@@ -210,7 +276,15 @@ class FaultPlan:
         """Call per sample decode inside a data worker; may hang or raise
         an injected transient ``OSError``."""
         for f in self.faults:
-            if f.kind == "worker_hang" and index == f.index \
+            if f.kind == "slow_host" \
+                    and worker_id == (f.worker if f.worker is not None
+                                      else 0):
+                # a persistent straggler, not a dead worker: identical
+                # bytes, just late — the straggler controller's food
+                self._slow_decodes += 1
+                if self._slow_decodes >= (f.step or 1):
+                    time.sleep(_SLOW_BASE_S * f.factor)
+            elif f.kind == "worker_hang" and index == f.index \
                     and (f.worker is None or f.worker == worker_id):
                 time.sleep(f.seconds if f.seconds else _HANG_SECONDS)
             elif f.kind == "io_error":

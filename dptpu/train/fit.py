@@ -257,6 +257,11 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         raise ValueError(f"--ckpt-keep {cfg.ckpt_keep} must be >= 1")
     fault_plan = FaultPlan.from_env()  # raises on a typo'd DPTPU_FAULT
     obs_conf = obs.obs_knobs()  # DPTPU_OBS_* knobs fail fast too
+    # elastic-lifecycle knobs (DPTPU_ELASTIC / DPTPU_QUORUM_DEADLINE_S /
+    # DPTPU_STRAGGLER_*) fail fast pre-compile under the same contract
+    from dptpu.resilience.elastic import elastic_knobs
+
+    el_conf = elastic_knobs()
     # large-batch engine knobs (optimizer / accumulation / warmup /
     # smoothing) fail fast pre-compile under the same locked contract
     opt_name, accum_steps, warmup_epochs, label_smooth = _opt_knobs(cfg)
@@ -449,6 +454,18 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     elif use_hier:
         from dptpu.parallel import make_hierarchical_mesh
 
+        if el_conf["elastic"] and cfg.resume:
+            # elastic composition first: a shrunk world that no longer
+            # divides --slices gets the message naming the knob AND
+            # both fallbacks (drop slices / pick a dividing S) instead
+            # of the generic mesh-factoring error. Gated on --resume:
+            # a FRESH run with DPTPU_ELASTIC exported (a job env knob
+            # that must survive restarts) is a plain slices
+            # misconfiguration and deserves the generic message, not a
+            # phantom elastic-restart diagnosis.
+            from dptpu.parallel.hierarchy import elastic_slices_check
+
+            elastic_slices_check(jax.device_count(), slices)
         # raises when slices does not divide the device count (or the
         # host count, multi-process) — the locked fail-fast contract
         mesh = make_hierarchical_mesh(slices)
@@ -736,6 +753,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     import os
 
     best_acc1, start_epoch, resume_step = 0.0, cfg.start_epoch, 0
+    elastic_resume = None  # set when DPTPU_ELASTIC re-maps a geometry
     if cfg.resume:
         # --resume accepts a file OR a directory; corrupt/truncated files
         # fall back to the newest VERIFIABLE checkpoint (CRC footer /
@@ -754,33 +772,96 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 resume_step = max(int(meta.get("step_in_epoch", 0)), 0)
                 # geometry cross-check: a mid-epoch replay is only
                 # exact when the run that resumes has the SAME batch
-                # geometry as the run that saved. Checkpoints carry
-                # their (world_size, global_batch, accum) tuple, so the
-                # fail-fast names BOTH tuples — the coordinates an
-                # elastic-resume remapper (ROADMAP item 3b) would need
-                # — instead of a bare mismatch. Pre-geometry files fall
+                # geometry as the run that saved — UNLESS DPTPU_ELASTIC
+                # opts into re-mapping the position onto this run's
+                # geometry (dptpu/resilience/elastic.py): the sampler's
+                # interleaved shard assignment makes the visited-index
+                # prefix geometry-independent, so the remainder replays
+                # exactly on the new world. Without the opt-in the
+                # fail-fast names BOTH tuples. Pre-geometry files fall
                 # back to the data_position cross-check below.
                 saved_geom = tuple(meta.get("geometry", (-1, -1, -1)))
                 if resume_step and saved_geom[0] >= 0 \
-                        and saved_geom != run_geom:
+                        and saved_geom != run_geom \
+                        and not el_conf["elastic"]:
                     raise ValueError(
                         f"'{resolved}' was saved mid-epoch (step "
                         f"{resume_step}) by a run with (world_size, "
                         f"global_batch, accum) = {saved_geom}, but this "
                         f"run is {run_geom} — the batch geometry "
                         f"changed, so the exact mid-epoch replay is "
-                        f"impossible. Resume on the saved geometry, or "
+                        f"impossible. Resume on the saved geometry, "
                         f"pass --start-epoch to restart from an epoch "
-                        f"boundary (elastic re-mapping onto a new "
-                        f"geometry is ROADMAP item 3b)."
+                        f"boundary, or set DPTPU_ELASTIC=1 to re-map "
+                        f"the saved position onto this geometry "
+                        f"(shrink/grow resume — the remainder of the "
+                        f"epoch replays exactly; the LR is rescaled "
+                        f"per the linear-scaling rule)."
+                    )
+                if resume_step and saved_geom[0] >= 0 \
+                        and saved_geom != run_geom:
+                    # the elastic shrink/grow remap (ROADMAP item 3a)
+                    from dptpu.resilience.elastic import (
+                        remap_resume_position,
+                    )
+
+                    remap = remap_resume_position(
+                        saved_geom, run_geom, resume_step,
+                        # the slices constraint binds only when the
+                        # hierarchical mesh is actually in play: a
+                        # single-device / TP / SP / GSPMD resume just
+                        # declared DPTPU_SLICES a no-op above, and the
+                        # remap must not fail over an ignored knob
+                        slices=slices if use_hier else 1,
+                        num_examples=len(train_ds),
+                    )
+                    # what the SAVED run trained at under the linear-
+                    # scaling rule — reconstructed from THIS run's base
+                    # --lr, since checkpoints do not stamp it: accurate
+                    # when the base LR is unchanged between attempts
+                    # (the normal elastic restart), labeled as such
+                    old_lr = (
+                        cfg.lr * saved_geom[1] / 256.0
+                        if cfg.variant == "apex" else cfg.lr
+                    )
+                    elastic_resume = {
+                        "saved_geometry": list(saved_geom),
+                        "new_geometry": list(run_geom),
+                        "consumed": remap.consumed,
+                        "resume_step_saved": resume_step,
+                        "resume_step": remap.new_step,
+                        "lr_saved": old_lr,  # assumes an unchanged base --lr
+                        "lr": derived.scaled_lr,
+                        "accum_changed": remap.accum_changed,
+                    }
+                    resume_step = remap.new_step
+                    # LOUD by contract, not verbose-gated: an elastic
+                    # restart changes the optimization trajectory (the
+                    # batch, and with it the linear-scaled LR) and that
+                    # must never scroll by silently
+                    print(
+                        f"=> ELASTIC RESUME: geometry {saved_geom} -> "
+                        f"{run_geom}; {remap.consumed} samples of the "
+                        f"epoch already trained, replaying the "
+                        f"remainder from step {remap.new_step} (was "
+                        f"step {elastic_resume['resume_step_saved']}); "
+                        f"LR {old_lr:g} -> {derived.scaled_lr:g} per "
+                        f"the linear-scaling rule (saved-run LR "
+                        f"reconstructed from this run's base --lr)"
+                        + (" ; accumulation depth changed — microbatch "
+                           "virtual-replica streams differ from the "
+                           "saved run" if remap.accum_changed else ""),
+                        file=sys.stderr,
                     )
                 # legacy (pre-geometry) files: the checkpoint's
                 # data_position (samples consumed per host) must agree
                 # with step x THIS run's host batch, or the replay
                 # contract is void — resuming would re-train (or skip)
-                # part of the epoch silently.
+                # part of the epoch silently. (An elastic remap above
+                # already re-expressed the position in THIS geometry.)
                 meta_dp = int(meta.get("data_position", -1))
-                if resume_step and meta_dp >= 0 \
+                if elastic_resume is None and resume_step \
+                        and meta_dp >= 0 \
                         and meta_dp != resume_step * host_batch:
                     raise ValueError(
                         f"'{resolved}' was saved at step {resume_step} "
@@ -1043,11 +1124,58 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         async_writer=ckpt_writer,
         geometry=run_geom,
     )
+    guard = PreemptionGuard()
+    # quorum coordination (dptpu/resilience/quorum.py): when a
+    # transport exists — DPTPU_QUORUM_DIR (tests/benches/single-machine
+    # pods) or the live jax.distributed KV service — a preemption that
+    # reaches only ONE host propagates through the store, the pod
+    # agrees on a common stop step, and the gathered mid-epoch save
+    # happens behind a barrier-with-deadline. No transport = the PR-2
+    # single-signal rules, unchanged; a single host degenerates to the
+    # plain PreemptionGuard path at the identical save position.
+    from dptpu.resilience.quorum import QuorumSession, make_coordinator
+
+    _quorum_dir = os.environ.get("DPTPU_QUORUM_DIR", "").strip() or None
+    _coord = make_coordinator(
+        derived.num_processes, derived.process_index,
+        el_conf["quorum_deadline_s"], directory=_quorum_dir,
+        # protocol keys scoped to this run ATTEMPT: the resume position
+        # is the one value every host derives identically, and it moves
+        # with each preemption — a restart pointed at the same store
+        # must not re-read the previous attempt's stop request
+        namespace=f"e{start_epoch:04d}s{resume_step:06d}-",
+    )
+    qs = QuorumSession(_coord, guard) if _coord is not None else None
+    if qs is not None and verbose:
+        print(
+            f"=> quorum save armed: {derived.num_processes} host(s), "
+            f"deadline {el_conf['quorum_deadline_s']:g}s"
+            + (f", store dir {_quorum_dir}" if _quorum_dir else
+               " over the jax.distributed KV service")
+        )
+    # host-lost verdict (the "gone for good" trigger for elastic
+    # resume): the fault harness — or, on a real pod, the chief's
+    # heartbeat monitor — flips this flag; the loop then stops cleanly,
+    # saves synchronously at the exact position, and the run reports
+    # host_lost so the operator restarts shrunk with DPTPU_ELASTIC=1.
+    lost = {"flag": False}
+
+    def _host_lost():
+        lost["flag"] = True
+        print(
+            "WARNING: host marked LOST (gone for good) — stopping with "
+            "a sync save at the current position; restart on the "
+            "shrunk world with DPTPU_ELASTIC=1 to replay the remainder",
+            file=sys.stderr,
+        )
+
     if fault_plan is not None:
         fault_plan.bind_worker_kill(train_loader.kill_one_worker)
+        fault_plan.bind_host_lost(_host_lost)
+        if qs is not None:
+            fault_plan.bind_quorum_request(qs.request_remote)
         if verbose:
             print(f"=> fault injection armed: DPTPU_FAULT={fault_plan.spec}")
-    guard = PreemptionGuard()
     # Emergency (single-host-initiated) saves must not enter a cross-host
     # gather: on a divergent failure only the raising host reaches the
     # handler, and a collective it enters alone hangs the job instead of
@@ -1060,12 +1188,29 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # Graceful-preemption saves may gather when the signal plausibly
         # reached every host: cluster preemption broadcasts SIGTERM, so
         # all hosts converge on the same save. A SIGINT (operator Ctrl-C
-        # on ONE host) must not enter a collective alone — skip the
+        # on ONE host) must not enter a collective alone — UNLESS the
+        # quorum barrier proves the whole pod checked in within the
+        # deadline (dptpu/resilience/quorum.py): then every host enters
+        # the gather together and the save is pod-consistent even for a
+        # single-host signal. No quorum / barrier timeout = skip the
         # gathered save (the boundary checkpoint stands) instead of
-        # hanging the pod. Full consensus is ROADMAP open item (a).
+        # hanging the pod.
         import signal as _signal
 
-        return emergency_ok or guard.signum == _signal.SIGTERM
+        if emergency_ok:
+            # no collective in this save (single host, or state never
+            # gathers): nothing to coordinate
+            return True
+        if qs is not None:
+            # EVERY host goes through the barrier — including the one
+            # that caught the SIGTERM. If the signal host skipped it
+            # (the pre-quorum rule below), its peers would wait for a
+            # check-in that never comes, time out, skip the save, and
+            # the signal host would enter the gather alone: the exact
+            # hang this module exists to prevent. All hosts stopped at
+            # the same agreed step, so the barrier tag matches.
+            return qs.save_barrier()
+        return guard.signum == _signal.SIGTERM
 
     def _drain_spans():
         # every drain of the shared tracer flows through here so an
@@ -1076,16 +1221,74 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             trigger.absorb(spans)
         return spans
 
-    # per-step tick: the profiling trigger's state machine rides the
-    # same post-step hook as fault injection (one call, two consumers)
-    _fault_tick = fault_plan.on_step if fault_plan else None
-    if trigger is not None:
-        def obs_tick():
-            trigger.tick()
-            if _fault_tick is not None:
-                _fault_tick()
+    # straggler-driven control (dptpu/resilience/elastic.py): armed by
+    # DPTPU_STRAGGLER_FACTOR on a process-mode feed — per-worker span
+    # latencies stream into P² quantiles and a persistently-slow worker
+    # escalates re-split → eviction through the loader seam. Thread
+    # mode has no worker pool to steer: the explicit knob gets a
+    # notice, never silence (the locked contract).
+    straggler = None
+    if el_conf["straggler_factor"] is not None and not cfg.evaluate:
+        if workers_mode == "process":
+            from dptpu.resilience.elastic import StragglerController
+
+            straggler = StragglerController(
+                train_loader,
+                el_conf["straggler_factor"],
+                persist=el_conf["straggler_persist"],
+                on_event=(trace_sink.log_event if trace_sink is not None
+                          else None),
+            )
+            if verbose:
+                print(
+                    f"=> straggler control armed: re-split at "
+                    f"{el_conf['straggler_factor']:g}x the healthiest "
+                    f"worker's span p50 for "
+                    f"{el_conf['straggler_persist']} consecutive "
+                    f"verdicts, eviction at 2x that"
+                )
+        elif verbose:
+            print("=> DPTPU_STRAGGLER_FACTOR ignored: thread-mode feed "
+                  "(set DPTPU_WORKERS_MODE=process to get a worker "
+                  "pool the controller can re-split/evict)")
+
+    # per-step tick: the profiling trigger, fault injection, the quorum
+    # protocol and the straggler controller all ride ONE post-step hook
+    # (order matters: faults fire before quorum reads the guard, so a
+    # same-step signal reaches agreement on the step it landed)
+    _ticks = [t for t in (
+        trigger.tick if trigger is not None else None,
+        fault_plan.on_step if fault_plan is not None else None,
+        qs.tick if qs is not None else None,
+        straggler.tick if straggler is not None else None,
+    ) if t is not None]
+    if not _ticks:
+        obs_tick = None
+    elif len(_ticks) == 1:
+        obs_tick = _ticks[0]
     else:
-        obs_tick = _fault_tick
+        def obs_tick():
+            for t in _ticks:
+                t()
+
+    def _stop_requested() -> bool:
+        # quorum runs defer the stop to the AGREED step so the pod
+        # stays consistent; without a coordinator the local guard (or
+        # the host-lost verdict) decides alone, as before
+        if lost["flag"]:
+            return True
+        if qs is not None:
+            return qs.should_stop()
+        return guard.requested
+
+    def _stop_reason() -> str:
+        if guard.signum is not None:
+            return guard.signal_name
+        if lost["flag"]:
+            return "host_lost"
+        if qs is not None and qs.stats()["reason"]:
+            return f"quorum:{qs.stats()['reason']}"
+        return "stop"
 
     result = {"history": [], "early_stopped": False, "training_time": None,
               "preempted": False}
@@ -1099,7 +1302,10 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         for epoch in range(start_epoch, cfg.epochs):
             start_step = resume_step if epoch == start_epoch else 0
             current_pos = {"epoch": epoch, "step": start_step}
-            if guard.requested:
+            if qs is not None:
+                qs.epoch_start(epoch, start_step)
+            if guard.requested or lost["flag"] \
+                    or (qs is not None and qs.stop_signaled()):
                 # the signal landed OUTSIDE the training loop (during the
                 # previous epoch's validation/boundary save): act on it
                 # before paying for another epoch's first step — the
@@ -1114,7 +1320,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 result["preempted"] = True
                 if verbose:
                     print(
-                        f"=> preempted ({guard.signal_name}) between "
+                        f"=> preempted ({_stop_reason()}) between "
                         f"epochs: "
                         + (f"saved '{path}' at epoch {epoch} step "
                            f"{start_step}" if path else
@@ -1151,7 +1357,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 verbose=verbose,
                 feed_stats=train_loader.feed_stats,
                 start_step=start_step,
-                should_stop=lambda: guard.requested,
+                should_stop=_stop_requested,
                 on_step=obs_tick,
                 ckpt_every=cfg.ckpt_steps,
                 ckpt_cb=_save_step if cfg.ckpt_steps else None,
@@ -1200,7 +1406,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 result["preempted"] = True
                 if verbose:
                     print(
-                        f"=> preempted ({guard.signal_name}): "
+                        f"=> preempted ({_stop_reason()}): "
                         + (f"saved '{path}' at epoch {epoch} step "
                            f"{train_stats['steps_done']}; --resume "
                            f"replays the sampler to this exact position"
@@ -1416,6 +1622,20 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 trace_sink.close()
         except Exception as e:
             teardown_errors.append(e)
+        if trace_sink is not None and derived.is_chief:
+            # the chief-side collector (ROADMAP item 3c): merge every
+            # host's obs-<host>.jsonl under the obs dir into ONE pod
+            # timeline — per-host streaming quantiles, windowed step
+            # p50s ("what changed at 14:07"), straggler verdicts —
+            # written atomically next to the logs it summarizes
+            try:
+                obs.merge_pod_timeline(
+                    trace_sink.directory,
+                    os.path.join(trace_sink.directory,
+                                 "pod-timeline.json"),
+                )
+            except Exception as e:
+                teardown_errors.append(e)
         obs.reset()
         if writer is not None:
             try:
@@ -1459,4 +1679,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             ds.close()
     result.update({"state": state, "best_acc1": best_acc1,
                    "epochs_run": len(result["history"])})
+    # elastic-lifecycle report: what the remap did, what the quorum
+    # agreed, what the straggler controller escalated — the benches'
+    # (and an operator's post-mortem's) machine-readable record
+    if elastic_resume is not None:
+        result["elastic"] = elastic_resume
+    if lost["flag"]:
+        result["host_lost"] = True
+    if qs is not None:
+        result["quorum"] = qs.stats()
+    if straggler is not None:
+        result["straggler"] = straggler.stats()
     return result

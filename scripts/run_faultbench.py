@@ -43,14 +43,51 @@ synthetic data, each compared against one uninterrupted baseline run:
                       (duplicate span completions are first-writer-wins
                       by construction).
 
-Writes ``FAULTBENCH.json`` at the repo root: faults injected, recoveries
-(pool restarts / span retries / resume fallbacks), and the resume
-trajectory's ``max |Δloss|`` — which this harness requires to be 0.0.
-Exit code is non-zero if any scenario loses bit-identity, so the bench
-doubles as a CI gate.
+Elastic pod-lifecycle scenarios (ROADMAP item 3 / the elastic
+tentpole), injected through the same harness:
 
-Usage: python scripts/run_faultbench.py [--images 96] [--batch 16]
-                                        [--epochs 2] [--arch resnet18]
+* ``shrink_resume`` — preempt mid-epoch, then resume on a SHRUNK
+                      geometry with ``DPTPU_ELASTIC=1``: the gates are
+                      (a) the visited-index set — trained prefix ∪
+                      elastic remainder vs the full epoch order —
+                      has Δ = ∅ (computed from the same pure sampler
+                      math the loaders run), and (b) the elastic
+                      replay is deterministic: a second identical
+                      elastic resume from a copy of the checkpoint
+                      (the same-geometry replay reference) matches
+                      params max |Δ| == 0 and loss Δ == 0.
+* ``lost_host``     — ``host_lost@step=N`` declares the host set
+                      permanently degraded: the run must stop with a
+                      sync save at the exact position, flag
+                      ``host_lost``, and the elastic resume on the
+                      smaller world must engage with the identical
+                      index-set exactness.
+* ``sigterm_one_host`` — the quorum save: the preemption notice
+                      arrives through the coordination store (this
+                      process catches NO signal), the pod agrees on a
+                      stop step, saves at it, and the same-geometry
+                      resume is bit-identical to the uninterrupted
+                      baseline; the scenario gates the protocol record
+                      (agreed_step == the saved step, not degraded) —
+                      pod-consistency made machine-checkable.
+* ``slow_host``     — a persistent straggler worker
+                      (``slow_host:factor=F``) under the armed
+                      straggler controller: re-split must ENGAGE
+                      (resplit + reissue counters > 0) and the run
+                      stays bit-identical (re-issued spans write
+                      identical bytes; eviction rides the proven
+                      worker_kill restart path).
+
+Writes ``FAULTBENCH.json`` at the repo root: faults injected, recoveries
+(pool restarts / span retries / resume fallbacks), and each scenario's
+gate verdict (``ok``). Exit code is non-zero if any scenario fails its
+gate, so the bench doubles as a CI gate. ``--smoke`` is the tier-1 CI
+preset (tests/test_faultbench_smoke.py): baseline + the four elastic
+scenarios on a smaller run — the chaos gates can never silently rot.
+
+Usage: python scripts/run_faultbench.py [--smoke] [--images 96]
+                                        [--batch 16] [--epochs 2]
+                                        [--arch resnet18]
                                         [--image-size 32] [--out PATH]
 """
 
@@ -81,7 +118,11 @@ _ENV_KNOBS = ("DPTPU_FAULT", "DPTPU_FAULT_SEED", "DPTPU_WORKERS_MODE",
               "DPTPU_DECODE_AHEAD", "DPTPU_SPECULATE", "DPTPU_READAHEAD",
               "DPTPU_STORE_RETRIES", "DPTPU_STORE_BACKOFF_S",
               "DPTPU_SHARD_CACHE_BYTES", "DPTPU_ODIRECT",
-              "DPTPU_STORE_FETCH")
+              "DPTPU_STORE_FETCH",
+              # elastic pod lifecycle (ROADMAP item 3)
+              "DPTPU_ELASTIC", "DPTPU_QUORUM_DIR",
+              "DPTPU_QUORUM_DEADLINE_S", "DPTPU_STRAGGLER_FACTOR",
+              "DPTPU_STRAGGLER_PERSIST")
 
 
 def make_jpeg_tree(root, n_train, n_val, n_classes=2):
@@ -146,6 +187,9 @@ def recoveries(result):
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: baseline + the elastic pod-"
+                         "lifecycle scenarios on a smaller run")
     ap.add_argument("--images", type=int, default=96)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--epochs", type=int, default=2)
@@ -155,6 +199,17 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "FAULTBENCH.json"))
     args = ap.parse_args()
+    if args.smoke:
+        # the tier-1 preset: same gates, smallest honest geometry
+        # (4 steps/epoch; the shrink lands on batch 8, and the
+        # consumed prefix 2 x 12 = 24 divides it). Only arguments left
+        # at their defaults are preset — an explicit --images/--batch/
+        # --epochs next to --smoke means "reproduce at THIS size" and
+        # must never be silently overridden.
+        for name, preset in (("images", 48), ("batch", 12),
+                             ("epochs", 2)):
+            if getattr(args, name) == ap.get_default(name):
+                setattr(args, name, preset)
 
     cfg = Config(
         data=f"synthetic:{args.images}",
@@ -172,9 +227,14 @@ def main():
 
     print(f"faultbench: {args.arch}@{args.image_size}px, "
           f"{steps_per_epoch} steps/epoch x {args.epochs} epochs, "
-          f"platform={jax.devices()[0].platform}")
+          f"platform={jax.devices()[0].platform}"
+          + (" [smoke]" if args.smoke else ""))
     base = run_fit(cfg, args.image_size, os.path.join(root, "baseline"))
     scenarios = []
+
+    if args.smoke:
+        elastic_scenarios(cfg, args, root, base, kill_step, scenarios)
+        return finish(args, cfg, base, scenarios, steps_per_epoch)
 
     # 1. sigterm: preempt mid-epoch 0, resume, compare
     d = os.path.join(root, "sigterm")
@@ -340,16 +400,201 @@ def main():
         "max_abs_dloss": trajectory_delta(base["history"], r["history"]),
     })
 
-    for s in scenarios:
-        s["bit_identical"] = (
-            s["params_max_delta"] == 0.0 and s["max_abs_dloss"] == 0.0
+    elastic_scenarios(cfg, args, root, base, kill_step, scenarios)
+    return finish(args, cfg, base, scenarios, steps_per_epoch)
+
+
+def elastic_scenarios(cfg, args, root, base, kill_step, scenarios):
+    """The ROADMAP-item-3 scenarios: shrink-resume, lost-host, quorum
+    one-host save, and the straggler-controlled slow worker (see module
+    docstring for each scenario's gate)."""
+    import shutil
+
+    from dptpu.data.sampler import ShardedSampler
+    from dptpu.resilience import step_checkpoint_name
+    from dptpu.resilience.elastic import remainder_indices
+
+    # the shrink: as close to 2/3 of the global batch as divides both
+    # the dataset and the consumed prefix ("an 8-host job restarts on
+    # 6") — an indivisible shrink would gate remap's own fail-fast
+    # instead of the replay
+    consumed = kill_step * args.batch
+    candidates = [
+        b for b in range(1, args.batch)
+        if args.images % b == 0 and consumed % b == 0
+    ]
+    assert candidates and args.images % args.batch == 0, (
+        f"pick --images/--batch with a dividing shrink "
+        f"(images={args.images} batch={args.batch} consumed={consumed})"
+    )
+    shrunk = min(candidates, key=lambda b: abs(b - 2 * args.batch / 3))
+
+    def index_set_delta():
+        # the Δ = ∅ oracle: trained prefix ∪ elastic remainder must
+        # equal the full epoch-0 visit order, computed from the SAME
+        # pure (seed, epoch) sampler math the loaders run
+        order = ShardedSampler(
+            args.images, shuffle=True, seed=cfg.seed
+        ).indices(0)
+        rem = remainder_indices(
+            args.images, seed=cfg.seed, epoch=0,
+            consumed=consumed, global_batch=shrunk,
         )
+        expected = set(int(i) for i in order[consumed:])
+        return len(expected.symmetric_difference(int(i) for i in rem))
+
+    # 8. shrink_resume: preempt, then resume on the shrunk geometry
+    # twice — the second replay (from a pristine copy of the
+    # checkpoint) is the same-geometry replay reference the first must
+    # match bit for bit
+    d = os.path.join(root, "shrink_resume")
+    r1 = run_fit(cfg, args.image_size, d,
+                 env={"DPTPU_FAULT": f"sigterm@step={kill_step}"})
+    d_ref = os.path.join(root, "shrink_resume_ref")
+    shutil.copytree(d, d_ref)
+    shrunk_cfg = cfg.replace(resume=".", batch_size=shrunk)
+    r2 = run_fit(shrunk_cfg, args.image_size, d,
+                 env={"DPTPU_ELASTIC": "1"})
+    r3 = run_fit(shrunk_cfg, args.image_size, d_ref,
+                 env={"DPTPU_ELASTIC": "1"})
+    el = r2.get("elastic") or {}
+    idx_delta = index_set_delta()
+    sc = {
+        "name": "shrink_resume",
+        "fault": f"sigterm@step={kill_step}, then DPTPU_ELASTIC=1 "
+                 f"resume at global batch {args.batch} -> {shrunk}",
+        "preempted": bool(r1["preempted"]),
+        "elastic": el,
+        "index_set_delta": idx_delta,
+        "lr_delta": (el.get("lr", 0.0) or 0.0)
+        - (el.get("lr_saved", 0.0) or 0.0),
+        "replay_params_max_delta": params_max_delta(
+            r2["state"], r3["state"]),
+        "replay_max_abs_dloss": trajectory_delta(
+            r2["history"], r3["history"]),
+    }
+    sc["ok"] = (
+        sc["preempted"] and idx_delta == 0
+        and el.get("consumed") == consumed
+        and el.get("resume_step") == consumed // shrunk
+        and sc["replay_params_max_delta"] == 0.0
+        and sc["replay_max_abs_dloss"] == 0.0
+        and r2["epochs_run"] == cfg.epochs
+    )
+    scenarios.append(sc)
+
+    # 9. lost_host: the gone-for-good verdict stops the run with a sync
+    # save at the exact position; the elastic resume on the smaller
+    # world engages with the identical remainder exactness
+    d = os.path.join(root, "lost_host")
+    r1 = run_fit(cfg, args.image_size, d,
+                 env={"DPTPU_FAULT": f"host_lost@step={kill_step}"})
+    resumed_from = find_resumable(d, verbose=False)
+    r2 = run_fit(cfg.replace(resume=".", batch_size=shrunk),
+                 args.image_size, d, env={"DPTPU_ELASTIC": "1"})
+    el = r2.get("elastic") or {}
+    sc = {
+        "name": "lost_host",
+        "fault": f"host_lost@step={kill_step}, then DPTPU_ELASTIC=1 "
+                 f"resume at global batch {shrunk}",
+        "host_lost": bool(r1.get("host_lost")),
+        "preempted": bool(r1["preempted"]),
+        "resumed_from": os.path.basename(resumed_from or ""),
+        "elastic": el,
+        "index_set_delta": index_set_delta(),
+    }
+    sc["ok"] = (
+        sc["host_lost"] and sc["preempted"]
+        and sc["resumed_from"] == step_checkpoint_name(0, kill_step)
+        and el.get("consumed") == consumed
+        and sc["index_set_delta"] == 0
+        and r2["epochs_run"] == cfg.epochs
+    )
+    scenarios.append(sc)
+
+    # 10. sigterm_one_host: the preemption notice arrives through the
+    # quorum store (no local signal); the pod agrees on a stop step,
+    # saves there, and the same-geometry resume is bit-identical —
+    # pod-consistency gated on the protocol record
+    d = os.path.join(root, "sigterm_one_host")
+    r1 = run_fit(cfg, args.image_size, d,
+                 env={"DPTPU_FAULT": f"sigterm_one_host@step={kill_step}",
+                      "DPTPU_QUORUM_DIR": os.path.join(d, "qdir")})
+    q = r1.get("quorum") or {}
+    resumed_from = find_resumable(d, verbose=False)
+    r2 = run_fit(cfg.replace(resume="."), args.image_size, d)
+    sc = {
+        "name": "sigterm_one_host",
+        "fault": f"sigterm_one_host@step={kill_step} (quorum store, "
+                 f"no local signal)",
+        "preempted": bool(r1["preempted"]),
+        "quorum": q,
+        "resumed_from": os.path.basename(resumed_from or ""),
+        "recoveries": recoveries(r2),
+        "params_max_delta": params_max_delta(base["state"], r2["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r2["history"]),
+    }
+    sc["ok"] = (
+        sc["preempted"]
+        and q.get("agreed_step") == kill_step
+        and not q.get("degraded")
+        and sc["resumed_from"] == step_checkpoint_name(0, kill_step)
+        and sc["params_max_delta"] == 0.0
+        and sc["max_abs_dloss"] == 0.0
+    )
+    scenarios.append(sc)
+
+    # 11. slow_host: a persistent straggler worker under the armed
+    # controller — re-split must engage (resplit + reissue counters)
+    # and the run must stay bit-identical to the thread-mode baseline
+    d = os.path.join(root, "slow_host")
+    r = run_fit(cfg, args.image_size, d,
+                env={"DPTPU_FAULT": "slow_host:factor=8@worker=0",
+                     "DPTPU_WORKERS_MODE": "process",
+                     "DPTPU_STRAGGLER_FACTOR": "2.0",
+                     "DPTPU_STRAGGLER_PERSIST": "2",
+                     "DPTPU_WORKER_TIMEOUT_S": "60"})
+    last = r["history"][-1] if r["history"] else {}
+    st = r.get("straggler") or {}
+    sc = {
+        "name": "slow_host",
+        "fault": "slow_host:factor=8@worker=0 (straggler controller "
+                 "armed: factor 2.0, persist 2)",
+        "preempted": bool(r["preempted"]),
+        "recoveries": recoveries(r),
+        "straggler": {k: v for k, v in st.items() if k != "events"},
+        "straggler_events": [e["kind"] for e in st.get("events", [])],
+        "straggler_reissues": int(last.get("train_straggler_reissues", 0)),
+        "resplits": int(last.get("train_straggler_resplits", 0)),
+        "evictions": int(last.get("train_worker_evictions", 0)),
+        "params_max_delta": params_max_delta(base["state"], r["state"]),
+        "max_abs_dloss": trajectory_delta(base["history"], r["history"]),
+    }
+    sc["ok"] = (
+        sc["resplits"] > 0
+        and sc["straggler_reissues"] > 0
+        and sc["params_max_delta"] == 0.0
+        and sc["max_abs_dloss"] == 0.0
+    )
+    scenarios.append(sc)
+
+
+def finish(args, cfg, base, scenarios, steps_per_epoch) -> int:
+    for s in scenarios:
+        if "params_max_delta" in s:
+            s["bit_identical"] = (
+                s["params_max_delta"] == 0.0 and s["max_abs_dloss"] == 0.0
+            )
+        # elastic scenarios precompute "ok"; legacy ones gate on
+        # bit-identity alone
+        s.setdefault("ok", s.get("bit_identical", False))
     from bench_util import host_provenance
 
     out = {
         "bench": "faultbench",
         "host": host_provenance(),
         "platform": jax.devices()[0].platform,
+        "smoke": bool(args.smoke),
         "config": {
             "arch": args.arch, "image_size": args.image_size,
             "images": args.images, "batch": args.batch,
@@ -358,14 +603,17 @@ def main():
         },
         "baseline_final_val_loss": base["history"][-1]["val_loss"],
         "scenarios": scenarios,
-        "all_bit_identical": all(s["bit_identical"] for s in scenarios),
+        "all_bit_identical": all(
+            s.get("bit_identical", True) for s in scenarios
+        ),
+        "all_ok": all(s["ok"] for s in scenarios),
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
     print(json.dumps(out, indent=2))
     print(f"wrote {args.out}")
-    return 0 if out["all_bit_identical"] else 1
+    return 0 if out["all_ok"] else 1
 
 
 if __name__ == "__main__":

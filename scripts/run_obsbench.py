@@ -6,12 +6,19 @@ Three claims the obs subsystem (dptpu/obs) makes, checked here:
 
 1. **Overhead**: step-phase tracing + the metrics registry cost < 2% of
    training throughput. Measured as interleaved tracer-off / tracer-on
-   ``fit()`` runs (best-of-``--reps`` per arm, off/on alternating so
-   machine drift hits both arms equally), on synthetic data so the feed
-   cannot hide host-side tracer cost behind JPEG decode. On a noisy
-   host the off-arm's own rep-to-rep spread is reported and the gate
-   widens to it — a 2% question cannot be answered on a box with 5%
-   run-to-run noise, and pretending otherwise would make the gate flap.
+   ``fit()`` pairs in ABBA order (off/on, on/off, ...): the overhead is
+   the MEDIAN of the per-pair ``(off - on)/off`` deltas —
+   adjacent-in-time pairs cancel between-pair drift, the alternating
+   order flips MONOTONIC (thermal/ramping-load) drift's sign pair to
+   pair so the median cancels that too, and the median discards a pair
+   a load spike still split — on
+   synthetic data so the feed cannot hide host-side tracer cost behind
+   JPEG decode. On a noisy host the gate widens to the measured noise
+   (the off arm's rep-to-rep spread and the paired-delta spread,
+   whichever is larger) — a 2% question cannot be answered on a box
+   with 5% run-to-run noise, and pretending otherwise makes the gate
+   flap under full-suite load (the PR-10 known constraint this
+   revision retires).
 2. **Coverage**: the epoch attribution report accounts for >= 95% of
    measured epoch wall time (residual reported as "other").
 3. **Trigger**: touching the ``DPTPU_OBS_TRIGGER`` sentinel during a
@@ -115,7 +122,19 @@ def main():
     attribution = None
     t0 = time.time()
     for rep in range(reps):
-        for arm, obs_on in (("off", False), ("on", True)):
+        # ABBA ordering: odd pairs run on-then-off. A pair is adjacent
+        # in time, but drift that ramps MONOTONICALLY across the bench
+        # (thermal, a neighboring job spinning up) still lands on the
+        # second run of every pair — with a fixed off-then-on order
+        # that reads as consistent tracer overhead across all pairs
+        # (measured exactly so under full-suite load: both pairs ~4%
+        # with ~1% off-arm spread). Alternating the order flips the
+        # drift's sign pair to pair, so the median cancels it while
+        # the paired spread widens the gate by its size.
+        arms = (("off", False), ("on", True))
+        if rep % 2:
+            arms = arms[::-1]
+        for arm, obs_on in arms:
             rate, result = run_fit(cfg, args.image_size, obs_on)
             rates[arm].append(round(rate, 1))
             if obs_on:
@@ -127,10 +146,31 @@ def main():
             print(f"rep {rep} tracer-{arm}: {rate:.1f} img/s")
     bench_s = time.time() - t0
     best_off, best_on = max(rates["off"]), max(rates["on"])
-    overhead_pct = max((best_off - best_on) / best_off * 100.0, 0.0)
+    # Overhead from PAIRED deltas: each rep's off/on runs are adjacent
+    # in time, so host drift (a full test suite hammering the box
+    # mid-bench) hits both arms of a pair roughly equally and cancels
+    # in the delta; the MEDIAN across pairs then discards any pair a
+    # load spike still split. The old best-of-arms comparison flaked
+    # exactly there — best_off sampled in a quiet moment vs best_on in
+    # a loaded one reads as tracer overhead (ROADMAP known constraint,
+    # noted since PR 10).
+    from statistics import median
+
+    paired = [
+        (off - on) / off * 100.0
+        for off, on in zip(rates["off"], rates["on"])
+    ]
+    overhead_pct = max(median(paired), 0.0)
+    # The gate can never be tighter than what this host can measure:
+    # the off arm's own rep-to-rep spread AND the paired-delta spread
+    # both widen it (interleaved repeats make each an honest noise
+    # floor — a 2% question cannot be answered through 5% noise).
     noise_pct = (max(rates["off"]) - min(rates["off"])) \
         / max(rates["off"]) * 100.0
-    effective_gate = max(args.gate_pct, noise_pct)
+    paired_spread_pct = (
+        max(paired) - min(paired) if len(paired) > 1 else 0.0
+    )
+    effective_gate = max(args.gate_pct, noise_pct, paired_spread_pct)
 
     # 3: the live trigger ---------------------------------------------
     obs_dir = tempfile.mkdtemp(prefix="dptpu_obsbench_obs_")
@@ -176,7 +216,10 @@ def main():
         "imgs_per_sec_tracer_on": rates["on"],
         "best_off": best_off,
         "best_on": best_on,
+        # median of per-rep (off - on)/off deltas — drift-cancelling
         "overhead_pct": round(overhead_pct, 3),
+        "paired_deltas_pct": [round(p, 3) for p in paired],
+        "paired_spread_pct": round(paired_spread_pct, 3),
         "off_arm_noise_pct": round(noise_pct, 3),
         "gate_pct": args.gate_pct,
         "effective_gate_pct": round(effective_gate, 3),

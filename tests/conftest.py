@@ -68,6 +68,12 @@ _FAST_MODULES = {
     # tier 1; the commbench smoke is the fifth fit-shaped exception
     # (one subprocess, --smoke preset, same gates as COMMBENCH.json)
     "test_hierarchy", "test_commbench_smoke",
+    # elastic pod lifecycle (PR 11): remap/quorum/straggler units are
+    # pure-fast (one pre-compile fail-fast fit); the faultbench smoke
+    # is the sixth fit-shaped exception — the shrink-resume, quorum and
+    # straggler chaos gates MUST hold in tier 1 (one subprocess,
+    # --smoke preset, same gates as FAULTBENCH.json)
+    "test_elastic", "test_faultbench_smoke",
 }
 
 
@@ -96,11 +102,17 @@ def dptpu_shm_leak_guard():
     (and, worse, silently recycle under) live batch views in
     production. The ``leaked_lease_count()``s only advance on
     close-with-lease-outstanding, so abandoned epochs whose leases the
-    generator backstop or a reset reclaimed stay clean."""
+    generator backstop or a reset reclaimed stay clean.
+
+    And the chief collector's merged-timeline temp files
+    (dptpu/obs/report.py ``merge_pod_timeline``): every merge must
+    either finish its atomic rename or unlink its temp — a temp still
+    tracked at session end was abandoned mid-write."""
     import glob
 
     from dptpu.data import shm as _shm
     from dptpu.data import stream as _stream
+    from dptpu.obs import report as _obs_report
     from dptpu.serve import staging as _serve_staging
 
     def lease_leaks():
@@ -108,6 +120,7 @@ def dptpu_shm_leak_guard():
                 + _serve_staging.leaked_lease_count())
 
     leases_before = lease_leaks()
+    merge_tmps_before = _obs_report.live_merge_tmp_count()
     # shard-file descriptors (the O_DIRECT/pread byte ring,
     # dptpu/data/stream.py): every reader a test opens must be closed
     # (dataset.close() or GC) by session end, or the suite fails
@@ -162,6 +175,10 @@ def dptpu_shm_leak_guard():
     assert _stream.open_fd_count() <= fds_before, (
         "shard-file descriptors leaked: a ShardFileReader opened during "
         "the suite was never closed (dataset.close() missing?)"
+    )
+    assert _obs_report.live_merge_tmp_count() == merge_tmps_before, (
+        "pod-timeline merge temp files leaked: a merge_pod_timeline "
+        "call neither completed its atomic rename nor unlinked its temp"
     )
 
 

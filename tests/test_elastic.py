@@ -1,0 +1,648 @@
+"""Elastic pod lifecycle (ROADMAP item 3): geometry re-mapping math,
+the quorum agreement protocol, streaming quantiles + the pod-timeline
+collector, the straggler controller, and the locked fail-fast contracts
+for every new knob and DPTPU_FAULT spec.
+
+The core exactness claim is pure arithmetic and locked here without a
+single compile: the sampler's interleaved shard assignment makes the
+visited-index PREFIX of an epoch geometry-independent, so a shrunk
+world resuming at ``consumed / new_global_batch`` visits exactly the
+untrained remainder. The fit()-level bit-identity lock lives in
+tests/test_fault_resume.py (one shared compile); the chaos gates in
+tests/test_faultbench_smoke.py.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dptpu.data.sampler import ShardedSampler
+from dptpu.obs.report import (
+    P2Quantile,
+    live_merge_tmp_count,
+    merge_pod_timeline,
+)
+from dptpu.resilience.elastic import (
+    StragglerController,
+    elastic_knobs,
+    remainder_indices,
+    remap_resume_position,
+)
+from dptpu.resilience.faults import FaultPlan
+from dptpu.resilience.quorum import (
+    FileKVStore,
+    QuorumCoordinator,
+    QuorumSession,
+    make_coordinator,
+)
+
+_KNOBS = ("DPTPU_ELASTIC", "DPTPU_QUORUM_DEADLINE_S",
+          "DPTPU_STRAGGLER_FACTOR", "DPTPU_STRAGGLER_PERSIST")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in _KNOBS + ("DPTPU_FAULT", "DPTPU_QUORUM_DIR"):
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+# ------------------------------------------------------------- knobs ----
+
+
+def test_elastic_knob_defaults():
+    assert elastic_knobs() == {
+        "elastic": False,
+        "quorum_deadline_s": 30.0,
+        "straggler_factor": None,
+        "straggler_persist": 2,
+    }
+
+
+def test_elastic_knob_explicit_values(monkeypatch):
+    monkeypatch.setenv("DPTPU_ELASTIC", "1")
+    monkeypatch.setenv("DPTPU_QUORUM_DEADLINE_S", "5.5")
+    monkeypatch.setenv("DPTPU_STRAGGLER_FACTOR", "3.0")
+    monkeypatch.setenv("DPTPU_STRAGGLER_PERSIST", "4")
+    assert elastic_knobs() == {
+        "elastic": True,
+        "quorum_deadline_s": 5.5,
+        "straggler_factor": 3.0,
+        "straggler_persist": 4,
+    }
+
+
+def test_elastic_knob_junk_raises(monkeypatch):
+    monkeypatch.setenv("DPTPU_ELASTIC", "maybe")
+    with pytest.raises(ValueError, match="DPTPU_ELASTIC"):
+        elastic_knobs()
+
+
+@pytest.mark.parametrize("bad", ["0", "-1", "junk"])
+def test_quorum_deadline_contract(monkeypatch, bad):
+    monkeypatch.setenv("DPTPU_QUORUM_DEADLINE_S", bad)
+    with pytest.raises(ValueError, match="DPTPU_QUORUM_DEADLINE_S"):
+        elastic_knobs()
+
+
+@pytest.mark.parametrize("bad", ["1", "1.0", "0.5", "nope"])
+def test_straggler_factor_contract(monkeypatch, bad):
+    monkeypatch.setenv("DPTPU_STRAGGLER_FACTOR", bad)
+    with pytest.raises(ValueError, match="DPTPU_STRAGGLER_FACTOR"):
+        elastic_knobs()
+
+
+@pytest.mark.parametrize("bad", ["0", "-2", "two"])
+def test_straggler_persist_contract(monkeypatch, bad):
+    monkeypatch.setenv("DPTPU_STRAGGLER_PERSIST", bad)
+    with pytest.raises(ValueError, match="DPTPU_STRAGGLER_PERSIST"):
+        elastic_knobs()
+
+
+# ------------------------------------------- DPTPU_FAULT new specs ----
+
+
+def test_fault_sigterm_one_host_needs_step():
+    with pytest.raises(ValueError, match="needs @step=N"):
+        FaultPlan("sigterm_one_host")
+    FaultPlan("sigterm_one_host@step=3")  # valid
+
+
+def test_fault_host_lost_needs_step():
+    with pytest.raises(ValueError, match="needs @step=N"):
+        FaultPlan("host_lost")
+    FaultPlan("host_lost@step=2")  # valid
+
+
+def test_fault_slow_host_needs_factor_above_one():
+    with pytest.raises(ValueError, match="factor=F with F > 1"):
+        FaultPlan("slow_host")
+    with pytest.raises(ValueError, match="not a valid value"):
+        FaultPlan("slow_host:factor=1.0")
+    with pytest.raises(ValueError, match="not a valid value"):
+        FaultPlan("slow_host:factor=grr")
+    plan = FaultPlan("slow_host:factor=5@step=3@worker=1")
+    f = plan.faults[0]
+    assert (f.factor, f.step, f.worker) == (5.0, 3, 1)
+
+
+def test_fault_modifier_error_names_factor():
+    with pytest.raises(ValueError, match="factor"):
+        FaultPlan("sigterm@nope=1")
+
+
+def test_fault_host_lost_fires_bound_callback():
+    plan = FaultPlan("host_lost@step=2")
+    fired = []
+    plan.bind_host_lost(lambda: fired.append(True))
+    plan.on_step()
+    assert not fired
+    plan.on_step()
+    assert fired == [True]
+    plan.on_step()  # fires once
+    assert fired == [True]
+
+
+def test_fault_sigterm_one_host_fires_quorum_callback():
+    plan = FaultPlan("sigterm_one_host@step=1")
+    fired = []
+    plan.bind_quorum_request(lambda: fired.append(True))
+    plan.on_step()
+    assert fired == [True]
+
+
+def test_fault_slow_host_sleeps_only_target_worker(monkeypatch):
+    import dptpu.resilience.faults as faults_mod
+
+    slept = []
+    monkeypatch.setattr(faults_mod.time, "sleep",
+                        lambda s: slept.append(s))
+    plan = FaultPlan("slow_host:factor=5@worker=1")
+    plan.worker_decode_hook(0, 10)  # wrong worker: no sleep
+    assert slept == []
+    plan.worker_decode_hook(1, 11)
+    assert slept == [pytest.approx(5 * faults_mod._SLOW_BASE_S)]
+
+
+# ------------------------------------------------- elastic remap math ----
+
+
+def visited_prefix(num_examples, num_shards, seed, epoch, steps,
+                   global_batch):
+    """What a pod of ``num_shards`` hosts visits in ``steps`` steps —
+    the union over hosts of each shard's first consumed samples."""
+    per_host = global_batch // num_shards
+    out = []
+    for shard in range(num_shards):
+        s = ShardedSampler(num_examples, num_shards=num_shards,
+                           shard_index=shard, shuffle=True, seed=seed)
+        out.append(s.indices(epoch)[: steps * per_host])
+    return set(int(i) for i in np.concatenate(out))
+
+
+@pytest.mark.parametrize("shards", [1, 2, 3, 4, 6])
+def test_visited_prefix_is_geometry_independent(shards):
+    """THE property elastic resume rests on: after k steps the visited
+    set is order[:k*global_batch] for ANY host factoring."""
+    order = ShardedSampler(96, shuffle=True, seed=7).indices(3)
+    got = visited_prefix(96, shards, seed=7, epoch=3, steps=2,
+                         global_batch=24)
+    assert got == set(int(i) for i in order[:48])
+
+
+@pytest.mark.parametrize("old_shards,new_shards,new_gb",
+                         [(2, 1, 16), (1, 3, 12), (4, 2, 8), (2, 2, 48)])
+def test_remainder_replay_is_exact(old_shards, new_shards, new_gb):
+    """Trained prefix (old geometry) ∪ elastic remainder (new geometry)
+    == the epoch's full drop_last visit set, Δ = ∅ — shrink AND grow."""
+    consumed = 48  # 2 steps x global batch 24 on the old geometry
+    trained = visited_prefix(96, old_shards, seed=1, epoch=0, steps=2,
+                             global_batch=24)
+    rem = remainder_indices(96, seed=1, epoch=0, consumed=consumed,
+                            global_batch=new_gb, num_shards=new_shards)
+    order = ShardedSampler(96, shuffle=True, seed=1).indices(0)
+    assert trained == set(int(i) for i in order[:consumed])
+    assert trained.union(int(i) for i in rem) == set(range(96))
+    assert trained.isdisjoint(int(i) for i in rem)
+
+
+def test_remap_resume_position_shrink():
+    r = remap_resume_position((8, 24, 1), (6, 16, 1), 2)
+    assert r.consumed == 48
+    assert r.new_step == 3
+    assert not r.accum_changed
+
+
+def test_remap_resume_position_grow_and_accum():
+    r = remap_resume_position((4, 16, 1), (8, 32, 2), 4)
+    assert r.consumed == 64
+    assert r.new_step == 2
+    assert r.accum_changed
+
+
+def test_remap_indivisible_consumed_fails_fast_naming_a_divisor():
+    # 2 x 24 = 48 consumed; new global batch 36 does not divide it
+    with pytest.raises(ValueError, match="whole number of steps") as ei:
+        remap_resume_position((8, 24, 1), (8, 36, 1), 2)
+    msg = str(ei.value)
+    assert "48" in msg and "36" in msg
+    assert "Pick a global batch that divides 48" in msg
+
+
+def test_remap_wrap_padding_guard():
+    # 3 x 24 = 72 consumed > 60 examples: the run was inside the
+    # sampler's wrap-around padding — exact remap impossible
+    with pytest.raises(ValueError, match="wrap-around padding"):
+        remap_resume_position((8, 24, 1), (8, 12, 1), 3, num_examples=60)
+
+
+def test_remap_slices_check_names_knob_and_both_fallbacks():
+    """The locked elastic x --slices message (satellite): a shrunk
+    world that no longer divides DPTPU_SLICES names the knob AND both
+    valid fallbacks (drop slices / pick a dividing S)."""
+    with pytest.raises(ValueError) as ei:
+        remap_resume_position((8, 24, 1), (6, 18, 1), 2, slices=4)
+    msg = str(ei.value)
+    assert "DPTPU_SLICES" in msg
+    assert "unset DPTPU_SLICES" in msg  # fallback 1: drop slices
+    assert "divides 6" in msg  # fallback 2: pick a dividing S
+    assert "DPTPU_SLICES=2" in msg  # ...with a concrete example
+    # a dividing S passes the check (and the remap proceeds)
+    r = remap_resume_position((8, 24, 1), (6, 16, 1), 2, slices=2)
+    assert r.new_step == 3
+
+
+def test_fit_elastic_slices_check_fires_before_mesh(tmp_path,
+                                                    monkeypatch):
+    """fit()-level lock: DPTPU_ELASTIC=1 on a RESUMING run with a
+    non-dividing DPTPU_SLICES fails fast with the elastic message (not
+    the generic mesh error) — before any compile. A fresh run with the
+    same knobs is a plain slices misconfiguration and keeps the generic
+    mesh-factoring error (no phantom elastic-restart diagnosis)."""
+    from dptpu.config import Config
+    from dptpu.train import fit
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("DPTPU_ELASTIC", "1")
+    monkeypatch.setenv("DPTPU_SLICES", "3")  # 3 does not divide 8
+
+    def _cfg(**kw):
+        return Config(data="synthetic:96", arch="resnet18", epochs=1,
+                      batch_size=24, workers=2, seed=1, **kw)
+
+    with pytest.raises(ValueError, match="unset DPTPU_SLICES"):
+        fit(_cfg(resume="."), image_size=32, verbose=False)
+    with pytest.raises(ValueError) as ei:
+        fit(_cfg(), image_size=32, verbose=False)  # fresh run
+    assert "elastic" not in str(ei.value)
+
+
+# ------------------------------------------------- streaming quantiles ----
+
+
+def test_p2_quantile_small_n_is_exact():
+    p = P2Quantile(0.5)
+    for x in (5.0, 1.0, 3.0):
+        p.add(x)
+    assert p.value() == 3.0
+    assert P2Quantile(0.5).value() == 0.0
+
+
+def test_p2_quantile_tracks_large_streams():
+    rng = np.random.RandomState(0)
+    for q in (0.5, 0.9):
+        xs = rng.gamma(2.0, 3.0, size=20000)
+        p = P2Quantile(q)
+        for x in xs:
+            p.add(float(x))
+        exact = float(np.quantile(xs, q))
+        assert abs(p.value() - exact) < 0.05 * exact
+
+
+def test_p2_quantile_rejects_bad_q():
+    for q in (0.0, 1.0, -0.5):
+        with pytest.raises(ValueError, match="P2Quantile"):
+            P2Quantile(q)
+
+
+def _write_host_log(directory, host, step_durs, t0=1000.0):
+    path = os.path.join(directory, f"obs-{host}.jsonl")
+    with open(path, "w") as f:
+        for i, d in enumerate(step_durs):
+            f.write(json.dumps({
+                "kind": "span", "name": "iter", "ts": t0 + i,
+                "dur_s": d, "step": i, "tid": 1,
+            }) + "\n")
+            f.write(json.dumps({
+                "kind": "span", "name": "data_wait", "ts": t0 + i,
+                "dur_s": d * 0.1, "step": i, "tid": 1,
+            }) + "\n")
+        f.write(json.dumps({
+            "kind": "epoch_report", "epoch": 0, "wall_s": sum(step_durs),
+            "data_wait_s": 0.1, "device_s": 0.8, "step_p50_s": 0.1,
+        }) + "\n")
+        f.write("not json at all\n")  # a torn line must not kill merge
+
+
+def test_merge_pod_timeline_finds_the_straggler(tmp_path):
+    d = str(tmp_path)
+    _write_host_log(d, "host-a", [0.10] * 40)
+    _write_host_log(d, "host-b", [0.10] * 40)
+    _write_host_log(d, "host-slow", [0.45] * 40)
+    out_path = os.path.join(d, "pod-timeline.json")
+    tl = merge_pod_timeline(d, out_path, window_s=10.0,
+                            straggler_factor=1.5)
+    assert sorted(tl["hosts"]) == ["host-a", "host-b", "host-slow"]
+    assert tl["stragglers"] == ["host-slow"]
+    ha = tl["hosts"]["host-a"]
+    assert ha["steps"] == 40
+    assert ha["step_p50_s"] == pytest.approx(0.10, abs=1e-6)
+    assert ha["spans"]["data_wait"]["count"] == 40
+    assert ha["windows"] and all(w["steps"] for w in ha["windows"])
+    assert ha["epochs"] == [{"epoch": 0, "wall_s": pytest.approx(4.0),
+                             "data_wait_s": 0.1, "device_s": 0.8,
+                             "step_p50_s": 0.1}]
+    assert ha["bad_lines"] == 1
+    # written atomically; no merge temp file left behind (the conftest
+    # leak guard polices the same counter session-wide)
+    with open(out_path) as f:
+        assert json.load(f)["stragglers"] == ["host-slow"]
+    assert live_merge_tmp_count() == 0
+    assert not [p for p in os.listdir(d) if p.endswith(".tmp")]
+
+
+def test_merge_pod_timeline_single_host_never_a_straggler(tmp_path):
+    _write_host_log(str(tmp_path), "only", [0.5] * 20)
+    tl = merge_pod_timeline(str(tmp_path))
+    assert tl["stragglers"] == []  # slowness is relative: need a peer
+
+
+# ---------------------------------------------------------- quorum ----
+
+
+class _Guard:
+    requested = False
+    signum = None
+
+
+def test_file_kv_store_roundtrip(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    assert kv.get("missing") is None
+    kv.put("stop", "v1")
+    kv.put("stop", "v2")  # overwrite is atomic
+    assert kv.get("stop") == "v2"
+    kv.put("ready-0", "a")
+    kv.put("ready-1", "b")
+    assert kv.scan("ready-") == {"ready-0": "a", "ready-1": "b"}
+
+
+def test_quorum_three_hosts_agree_on_max_ready(tmp_path):
+    """The protocol across three concurrent hosts (threads over the
+    shared directory store): the request propagates, every host posts
+    READY at its own step and HOLDS inside the tick until the pod
+    agrees (no host may dispatch past the agreed step), the agreed stop
+    is max(ready), everyone stops exactly there, and the save barrier
+    admits the full pod."""
+    import threading
+
+    kv = FileKVStore(str(tmp_path))
+    coords = [QuorumCoordinator(kv, h, 3, deadline_s=5.0)
+              for h in range(3)]
+    sessions = [QuorumSession(c, _Guard()) for c in coords]
+    barrier_ok = [None] * 3
+
+    def host(h, presteps, request):
+        s = sessions[h]
+        s.epoch_start(0, 0)
+        for _ in range(presteps):
+            s.tick()
+        if request:
+            s.request_remote("sigterm_one_host")
+        while not s.should_stop():
+            s.tick()
+            time.sleep(0.002)
+        barrier_ok[h] = s.save_barrier()
+
+    threads = [
+        threading.Thread(target=host, args=(h, n, h == 1))
+        for h, n in enumerate([5, 7, 6])  # out of phase, as on a pod
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert all(s.should_stop() for s in sessions)
+    stats = [s.stats() for s in sessions]
+    agreed = {st["agreed_step"] for st in stats}
+    assert len(agreed) == 1  # pod-consistent
+    assert agreed == {max(st["ready_step"] for st in stats)}
+    assert {st["stopped_at"] for st in stats} == agreed
+    assert not any(st["degraded"] for st in stats)
+    assert barrier_ok == [True] * 3
+
+
+def test_quorum_deadline_degrades_instead_of_hanging(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    coords = [QuorumCoordinator(kv, h, 3, deadline_s=0.05)
+              for h in range(3)]
+    s0 = QuorumSession(coords[0], _Guard())
+    s0.epoch_start(0, 0)
+    for _ in range(3):
+        s0.tick()
+    s0.request_remote("sigterm_one_host")
+    # hosts 1 and 2 never answer (they are the ones dying): the READY
+    # hold expires after deadline_s and the requester stops at its own
+    # step, flagged degraded — bounded, never a hang
+    t0 = time.monotonic()
+    s0.tick()
+    assert time.monotonic() - t0 < 2.0
+    assert s0.should_stop()
+    assert s0.stats()["degraded"] is True
+    # a degraded protocol refuses the pod-consistent save barrier
+    assert s0.save_barrier() is False
+
+
+def test_quorum_single_host_degenerates_to_preemption_guard(tmp_path):
+    """One host: signal → ready → agreed == own step → stop on the
+    SAME tick, exactly the PreemptionGuard timing."""
+    kv = FileKVStore(str(tmp_path))
+    s = QuorumSession(QuorumCoordinator(kv, 0, 1, deadline_s=5.0),
+                      _Guard())
+    s.epoch_start(0, 0)
+    s.tick()
+    s.tick()
+    assert not s.should_stop()
+    s.guard = type("G", (), {"requested": True, "signum": 15})()
+    s.tick()  # the tick after the signal lands
+    assert s.should_stop()
+    st = s.stats()
+    assert st["agreed_step"] == st["stopped_at"] == 3
+    assert st["degraded"] is False
+    assert s.save_barrier() is True
+
+
+def test_quorum_namespace_isolates_run_attempts(tmp_path):
+    """A restart pointed at the SAME store directory must not re-read
+    the previous attempt's stop request and re-preempt itself: protocol
+    keys are scoped by the per-attempt namespace (fit derives it from
+    the resume position). Heartbeats stay global — liveness spans
+    attempts and ages out by timestamp."""
+    kv = FileKVStore(str(tmp_path))
+    first = QuorumCoordinator(kv, 0, 1, deadline_s=5.0,
+                              namespace="e0000s000000-")
+    first.request_stop(3, reason="sigterm")
+    first.post_ready(3)
+    assert first.pending_stop() is not None
+    # the resumed attempt (new position -> new namespace) sees nothing
+    resumed = QuorumCoordinator(kv, 0, 1, deadline_s=5.0,
+                                namespace="e0000s000003-")
+    assert resumed.pending_stop() is None
+    assert resumed.ready_steps() == {}
+    s = QuorumSession(resumed, _Guard())
+    s.epoch_start(0, 3)
+    s.tick()
+    assert not s.should_stop()
+    assert not s.stop_signaled()
+
+
+def test_quorum_missing_hosts(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    c0 = QuorumCoordinator(kv, 0, 3, deadline_s=5.0)
+    c1 = QuorumCoordinator(kv, 1, 3, deadline_s=5.0)
+    c0.heartbeat(4)
+    c1.heartbeat(4)
+    assert c0.missing_hosts(timeout_s=60.0) == [2]  # never beat at all
+
+
+def test_make_coordinator_prefers_directory(tmp_path):
+    c = make_coordinator(1, 0, 30.0, directory=str(tmp_path))
+    assert isinstance(c.store, FileKVStore)
+    # no directory, single host, no jax.distributed session: no
+    # transport -> fit keeps the PR-2 rules
+    assert make_coordinator(1, 0, 30.0) is None
+
+
+# ------------------------------------------------ straggler controller ----
+
+
+class _FakeLoader:
+    """Scripted loader seam: per-tick latency observations plus a
+    record of every escalation call."""
+
+    def __init__(self, script):
+        self.script = list(script)  # one list of (wid, lat) per tick
+        self.resplit_calls = []
+        self.restore_calls = []
+        self.evict_calls = []
+        self.pending = 3
+
+    def worker_latency_observations(self):
+        return self.script.pop(0) if self.script else []
+
+    def resplit_worker(self, w):
+        self.resplit_calls.append(w)
+        return self.pending
+
+    def restore_worker(self, w):
+        self.restore_calls.append(w)
+
+    def evict_worker(self, w):
+        self.evict_calls.append(w)
+        return 12345
+
+
+def test_straggler_controller_resplits_then_evicts():
+    # worker 0 persistently 10x slower than worker 1: ready at tick 4
+    # (min_obs), strikes 2 -> re-split at tick 5 and PROBATION starts
+    # on a fresh verdict window (min_obs again at tick 9); still slow
+    # for persist=2 fresh verdicts -> eviction at tick 10
+    tick_obs = [[(0, 0.5), (1, 0.05)]] * 12
+    loader = _FakeLoader(tick_obs)
+    events = []
+    c = StragglerController(loader, factor=2.0, persist=2, min_obs=4,
+                            on_event=lambda k, p: events.append(k))
+    for _ in range(12):
+        c.tick()
+    assert loader.resplit_calls == [0]  # re-split fires ONCE per bout
+    assert loader.evict_calls == [0]  # probation still slow -> evicted
+    assert loader.restore_calls == []  # never recovered
+    assert c.stats()["resplits"] == 1
+    assert c.stats()["evictions"] == 1
+    assert events == ["straggler_resplit", "straggler_evict"]
+    ev = c.stats()["events"]
+    assert ev[0]["reissued_spans"] == 3
+    assert ev[1]["pid"] == 12345
+
+
+def test_straggler_controller_restores_a_recovered_worker():
+    # slow until the re-split, healthy on the fresh probation window:
+    # the worker is RESTORED to the affinity router, never evicted —
+    # the transient-slowdown case must not end in a SIGKILL
+    script = [[(0, 0.5), (1, 0.05)]] * 5 + [[(0, 0.05), (1, 0.05)]] * 7
+    loader = _FakeLoader(script)
+    c = StragglerController(loader, factor=2.0, persist=2, min_obs=4)
+    for _ in range(12):
+        c.tick()
+    assert loader.resplit_calls == [0]
+    assert loader.restore_calls == [0]
+    assert loader.evict_calls == []
+
+
+def test_straggler_controller_probes_a_drained_suspect():
+    # after the re-split the routed-away worker's backlog drains and it
+    # produces NO new observations: the verdict freezes (no strikes on
+    # stale numbers) until probe_after evidence-free ticks, then the
+    # worker is PROBED — re-admitted to the router with the verdict
+    # window still armed — so probation can always resolve instead of
+    # benching a transiently-slow worker forever
+    script = [[(0, 0.5), (1, 0.05)]] * 5 + [[(1, 0.05)]] * 7
+    loader = _FakeLoader(script)
+    events = []
+    c = StragglerController(loader, factor=2.0, persist=2, min_obs=4,
+                            on_event=lambda k, p: events.append(k))
+    for _ in range(7):
+        c.tick()
+    # re-split at tick 5; only 2 evidence-free ticks so far: frozen
+    assert loader.resplit_calls == [0]
+    assert loader.restore_calls == []
+    assert loader.evict_calls == []
+    for _ in range(5):
+        c.tick()
+    # probe_after = max(2*persist, 4) = 4 evidence-free ticks -> probed
+    assert loader.restore_calls == [0]
+    assert "straggler_probe" in events
+    assert loader.evict_calls == []  # verdict stays armed, not evicted
+
+
+def test_straggler_controller_probe_then_still_slow_evicts():
+    # the probed worker's fresh spans read slow again: probation
+    # resumes on real evidence and escalates to eviction
+    script = ([[(0, 0.5), (1, 0.05)]] * 5  # -> re-split at tick 5
+              + [[(1, 0.05)]] * 4  # backlog drained -> probe at tick 9
+              + [[(0, 0.5), (1, 0.05)]] * 6)  # probed spans still slow
+    loader = _FakeLoader(script)
+    c = StragglerController(loader, factor=2.0, persist=2, min_obs=4)
+    for _ in range(15):
+        c.tick()
+    assert loader.resplit_calls == [0]
+    assert loader.restore_calls == [0]  # the probe re-admission
+    assert loader.evict_calls == [0]  # fresh evidence convicts
+
+
+def test_straggler_controller_needs_a_peer():
+    # a single worker can never be a straggler: slowness is relative
+    loader = _FakeLoader([[(0, 0.5)]] * 10)
+    c = StragglerController(loader, factor=2.0, persist=1, min_obs=2)
+    for _ in range(10):
+        c.tick()
+    assert loader.resplit_calls == []
+    assert c.stats()["resplits"] == 0
+
+
+def test_straggler_controller_healthy_pool_never_escalates():
+    loader = _FakeLoader([[(0, 0.05), (1, 0.06)]] * 10)
+    c = StragglerController(loader, factor=2.0, persist=1, min_obs=2)
+    for _ in range(10):
+        c.tick()
+    assert loader.resplit_calls == []
+    assert loader.evict_calls == []
+
+
+def test_straggler_controller_recovery_clears_strikes():
+    # slow for one tick, then healthy: persist=2 never reached
+    script = [[(0, 0.5), (1, 0.05)]] + [[(0, 0.05), (1, 0.05)]] * 8
+    loader = _FakeLoader(script)
+    c = StragglerController(loader, factor=2.0, persist=2, min_obs=2)
+    for _ in range(9):
+        c.tick()
+    assert loader.resplit_calls == []
+
+
+def test_straggler_controller_validates_params():
+    with pytest.raises(ValueError, match="factor"):
+        StragglerController(_FakeLoader([]), factor=1.0)
+    with pytest.raises(ValueError, match="persist"):
+        StragglerController(_FakeLoader([]), factor=2.0, persist=0)

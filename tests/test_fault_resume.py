@@ -192,6 +192,78 @@ def test_ckpt_steps_rotation_and_corrupt_fallback(baseline, tmp_path,
         r2["history"][1]["val_loss"]
 
 
+def test_elastic_shrink_resume_replays_exact_remainder(baseline, tmp_path,
+                                                      monkeypatch):
+    """The elastic tentpole at fit() level (ROADMAP item 3a): a run
+    preempted at step 2 of an (8, 24, 1) geometry resumes on the SHRUNK
+    (8, 16, 1) geometry under DPTPU_ELASTIC=1 — the remapped position
+    (48 consumed / 16 = step 3 of 6) replays exactly the untrained
+    remainder (index-set Δ = ∅ against the pure sampler oracle), the
+    replay is deterministic (a second elastic resume from a pristine
+    copy of the checkpoint is bit-identical in params AND losses), and
+    the remap details land in result["elastic"]. Without the opt-in the
+    geometry mismatch still fails fast, now naming DPTPU_ELASTIC."""
+    import shutil
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=2")
+    r1 = fit(_cfg(), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    assert os.path.exists(step_checkpoint_name(0, 2))
+    monkeypatch.delenv("DPTPU_FAULT")
+
+    # the fail-fast without the opt-in now names the elastic knob
+    with pytest.raises(ValueError, match="DPTPU_ELASTIC"):
+        fit(_cfg(resume=".", batch_size=16), image_size=32, verbose=False)
+
+    # a pristine copy is the same-geometry replay reference's source
+    os.makedirs("ref")
+    for f in os.listdir("."):
+        if f.startswith("checkpoint"):
+            shutil.copy(f, os.path.join("ref", f))
+
+    monkeypatch.setenv("DPTPU_ELASTIC", "1")
+    # an indivisible consumed prefix still fails fast, naming a fix:
+    # 48 consumed does not split into whole batches of 36
+    with pytest.raises(ValueError, match="Pick a global batch"):
+        fit(_cfg(resume=".", batch_size=36), image_size=32, verbose=False)
+
+    r2 = fit(_cfg(resume=".", batch_size=16), image_size=32, verbose=False)
+    assert r2["epochs_run"] == 2
+    el = r2["elastic"]
+    assert el["saved_geometry"] == [8, 24, 1]
+    assert el["new_geometry"] == [8, 16, 1]
+    assert el["consumed"] == 48
+    assert el["resume_step"] == 3
+    # the resumed epoch trained exactly the 3-step remainder (96 - 48
+    # = 48 samples at the new global batch of 16)
+    assert r2["history"][0]["train_num_batches"] == 3
+    assert r2["history"][0]["train_steps_done"] == 6
+
+    # Δ = ∅: trained prefix ∪ elastic remainder == the epoch-0 visit
+    # set, straight from the pure (seed, epoch) sampler math the
+    # loaders run
+    from dptpu.data.sampler import ShardedSampler
+    from dptpu.resilience.elastic import remainder_indices
+
+    order = ShardedSampler(96, shuffle=True, seed=1).indices(0)
+    rem = remainder_indices(96, seed=1, epoch=0, consumed=48,
+                            global_batch=16)
+    assert set(int(i) for i in order[:48]).union(
+        int(i) for i in rem) == set(range(96))
+    assert np.array_equal(np.sort(np.asarray(order[48:])), rem)
+
+    # the same-geometry replay reference: a second elastic resume from
+    # the pristine checkpoint copy must be bit-identical
+    monkeypatch.chdir(tmp_path / "ref")
+    r3 = fit(_cfg(resume=".", batch_size=16), image_size=32, verbose=False)
+    assert _params_max_delta(r2["state"], r3["state"]) == 0.0
+    for h2, h3 in zip(r2["history"], r3["history"]):
+        assert h2["val_loss"] == h3["val_loss"]
+        assert h2["train_loss"] == h3["train_loss"]
+    monkeypatch.delenv("DPTPU_ELASTIC")
+
+
 def test_emergency_checkpoint_on_unexpected_crash(tmp_path, monkeypatch):
     """An exception mid-epoch (not a signal — a bug, an OOM, a loader
     blow-up) still leaves a resumable checkpoint at the last completed

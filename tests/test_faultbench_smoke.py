@@ -1,0 +1,76 @@
+"""Tier-1 smoke of scripts/run_faultbench.py --smoke (the obsbench /
+commbench pattern): the elastic pod-lifecycle chaos gates — shrink-
+resume remainder exactness, quorum pod-consistency, straggler re-split
+engagement — run continuously, not just on the bench host, so they can
+never silently rot. One subprocess, smallest preset, same gate logic as
+the committed FAULTBENCH.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_faultbench_smoke_gates(tmp_path):
+    out = str(tmp_path / "FAULTBENCH.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # run on the REAL single-CPU topology (the obsbench-smoke
+    # precedent): the chaos contract under test — determinism across
+    # preemption/remap — is topology-independent, and the fake 8-device
+    # pod the conftest forces would only multiply compile time
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_faultbench.py"),
+         "--smoke", "--out", out],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"faultbench gate failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+    with open(out) as f:
+        bench = json.load(f)
+    assert bench["smoke"] is True
+    by_name = {s["name"]: s for s in bench["scenarios"]}
+    assert sorted(by_name) == ["lost_host", "shrink_resume",
+                               "sigterm_one_host", "slow_host"]
+    assert bench["all_ok"], by_name
+
+    # shrink-resume: the untrained remainder replays EXACTLY — the
+    # visited-index set difference is empty and the elastic replay is
+    # bit-identical to its same-geometry replay reference
+    sr = by_name["shrink_resume"]
+    assert sr["index_set_delta"] == 0
+    assert sr["replay_params_max_delta"] == 0.0
+    assert sr["replay_max_abs_dloss"] == 0.0
+    assert sr["elastic"]["consumed"] == \
+        sr["elastic"]["resume_step"] * sr["elastic"]["new_geometry"][1]
+
+    # lost-host: the gone-for-good verdict saved at the exact position
+    # and the elastic resume engaged with the same exactness
+    lh = by_name["lost_host"]
+    assert lh["host_lost"] and lh["preempted"]
+    assert lh["index_set_delta"] == 0
+
+    # quorum one-host save: the protocol record proves pod-consistency
+    # (agreed step == the step the checkpoint names, not degraded) and
+    # the same-geometry resume is bit-identical to the baseline
+    q = by_name["sigterm_one_host"]
+    assert q["quorum"]["agreed_step"] is not None
+    assert not q["quorum"]["degraded"]
+    assert f"s{q['quorum']['agreed_step']:06d}" in q["resumed_from"]
+    assert q["bit_identical"]
+
+    # slow-host: re-split ENGAGED (resplit + reissue counters moved)
+    # and the straggler never cost bit-identity
+    sh = by_name["slow_host"]
+    assert sh["resplits"] > 0
+    assert sh["straggler_reissues"] > 0
+    assert sh["bit_identical"]
