@@ -1,0 +1,33 @@
+"""Repo-invariant static analysis — ``dptpu check``.
+
+Two halves (ISSUE 12):
+
+* **AST lint engine** (:mod:`dptpu.analysis.lint`, rules in
+  :mod:`dptpu.analysis.rules`): stdlib-``ast`` lints for the contracts
+  the repo otherwise enforces only by convention — the fail-fast
+  ``DPTPU_*`` knob rule, determinism inside the ``(seed, epoch, index)``
+  bit-identity surfaces, no host syncs in the hot path, ``/dev/shm``
+  segment hygiene, and the explicit-collectives shard_map discipline.
+  Findings are suppressible per line with
+  ``# dptpu: allow-<rule>(<reason>)`` — a reason is MANDATORY.
+
+* **HLO budget gates** (:mod:`dptpu.analysis.hlo_budget`): compile the
+  representative step configs (DDP, ZeRO-1, accum, ``--slices``) on the
+  CPU backend and assert the committed ``HLO_BUDGETS.json`` — per-link
+  collective ops/bytes matching the analytic formulas locked in
+  tests/test_hierarchy.py, donation honored, no f64 ops — so a
+  comms/sharding regression fails ``dptpu check`` before any bench runs.
+
+This module and the lint half import NOTHING heavy (no jax/numpy at
+module scope) so the check can run inside spawned data workers and in
+jax-free CI shards; only the HLO half touches jax, lazily.
+"""
+
+from dptpu.analysis.lint import (  # noqa: F401
+    Finding,
+    iter_rules,
+    lint_paths,
+    lint_repo,
+    lint_source,
+)
+from dptpu.analysis.knobs import KNOB_REGISTRY  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m dptpu.analysis`` — ``dptpu check`` without loading the
+trainer CLI (dptpu/cli.py imports the full train stack at module
+scope; this entry keeps lint-only runs stdlib-light)."""
+
+from dptpu.analysis.cli import main_check
+
+if __name__ == "__main__":
+    raise SystemExit(main_check())

@@ -1,0 +1,116 @@
+"""``dptpu check`` — the static-analysis CLI.
+
+Exit-code contract (LOCKED by tests/test_analysis_repo.py):
+
+* ``0`` — clean: zero unsuppressed lint findings, every suppression
+  carries a reason, and (unless ``--no-hlo``) every HLO budget gate
+  holds;
+* ``1`` — findings: at least one unsuppressed finding or budget
+  violation (each printed with the locked actionable message);
+* ``2`` — usage/internal error (argparse's own convention).
+
+``--no-hlo`` keeps the run stdlib-only (no jax import) — safe inside
+spawned data workers and jax-free CI shards. ``python -m
+dptpu.analysis`` is the same entry without loading the trainer CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def build_check_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dptpu check",
+        description="repo-invariant static analysis: AST lints "
+                    "(knob-contract / determinism / host-sync / "
+                    "shm-hygiene / shard-map) + HLO budget gates "
+                    "(dptpu/analysis)",
+    )
+    p.add_argument("--root", default=".", metavar="DIR",
+                   help="repo root to check (default: .)")
+    p.add_argument("--no-hlo", action="store_true",
+                   help="lint only — skip the HLO budget gates (and "
+                        "with them any jax import; worker-safe)")
+    p.add_argument("--update-hlo-budgets", action="store_true",
+                   help="recompile the representative configs and "
+                        "re-commit HLO_BUDGETS.json (for INTENDED "
+                        "comms/sharding changes)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the machine-readable report "
+                        "(ANALYSIS.json format) to PATH")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="print findings only, no summary")
+    return p
+
+
+def main_check(argv=None) -> int:
+    import sys
+
+    from dptpu.analysis.lint import DEFAULT_SCAN_ROOTS
+
+    parser = build_check_parser()
+    args = parser.parse_args(argv)
+    if args.update_hlo_budgets and args.no_hlo:
+        # committing a table the gates never validated would exit 0
+        # "clean" over an unchecked budget — refuse the combination
+        parser.error(
+            "--update-hlo-budgets needs the HLO gates it re-commits — "
+            "drop --no-hlo"
+        )
+    root = args.root
+    if not any(os.path.isdir(os.path.join(root, d))
+               for d in DEFAULT_SCAN_ROOTS):
+        # a mis-set CI root must not scan zero files and report "clean"
+        print(
+            f"dptpu check: none of {'/'.join(DEFAULT_SCAN_ROOTS)} "
+            f"exists under --root {root!r} — wrong directory? "
+            f"(a clean exit over zero files would hide every finding)",
+            file=sys.stderr,
+        )
+        return 2
+    computed = None
+    if args.update_hlo_budgets:
+        from dptpu.analysis.hlo_budget import (
+            compute_budgets,
+            write_budgets,
+        )
+
+        computed = compute_budgets()
+        path = write_budgets(root, computed)
+        if not args.quiet:
+            print(f"=> wrote {path}")
+    from dptpu.analysis.report import build_report, write_report
+
+    report = build_report(root, run_hlo=not args.no_hlo,
+                          computed=computed)
+    for line in report["lint"]["findings"]:
+        print(line)
+    for line in report.get("hlo", {}).get("violations", ()):
+        print(line)
+    if args.json:
+        write_report(report, args.json)
+    if not args.quiet:
+        lint = report["lint"]
+        hlo = report["hlo"]
+        hlo_note = (
+            "skipped" if hlo["ok"] is None
+            else ("ok" if hlo["ok"] else "FAILED")
+        )
+        print(
+            f"=> dptpu check: {lint['files_scanned']} files, "
+            f"{len(lint['findings'])} finding(s), "
+            f"{len(lint['suppressions'])} reasoned suppression(s), "
+            f"HLO budgets {hlo_note} — "
+            f"{'clean' if report['ok'] else 'NOT CLEAN'}"
+        )
+    return 0 if report["ok"] else 1
+
+
+def console_check(argv=None) -> int:
+    return main_check(argv)
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised as a module
+    raise SystemExit(main_check())

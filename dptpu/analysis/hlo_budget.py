@@ -1,0 +1,383 @@
+"""HLO static budget gates — the compile-time half of ``dptpu check``.
+
+Compiles the representative step configs on the CPU backend (the fake
+8-device pod, tests/conftest.py's trick) and statically asserts the
+committed budget table ``HLO_BUDGETS.json``:
+
+* per-link collective instruction counts and per-chip ring-send bytes
+  EXACTLY as committed, and within 2% of the analytic formulas locked
+  in tests/test_hierarchy.py (flat DDP: ``2(n-1)/n × (G + P)`` of pure
+  all-reduce; ZeRO-1: same total volume as DDP; accum: identical
+  collectives to DDP — ONE reduction per update; hierarchical:
+  RS+AG on ICI at ``2(I-1)/I·G``, the shard-sized AR crossing DCN at
+  ``2(S-1)/S·G/I`` plus the world pmean);
+* donation honored — the compiled module's ``input_output_alias`` map
+  covers at least every parameter leaf, so the update never
+  materializes a full-parameter copy;
+* zero f64 shapes anywhere (no accidental double promotion).
+
+A comms/sharding regression therefore fails ``dptpu check`` BEFORE any
+bench runs. After an INTENDED change, re-commit the table with
+``dptpu check --update-hlo-budgets``.
+
+All jax/flax imports are lazy: importing this module (and the lint
+half of dptpu.analysis) stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+BUDGETS_FILENAME = "HLO_BUDGETS.json"
+
+# the representative geometries: 4 fake devices, 2 slices × 2 chips for
+# the hierarchical arm (the tests/test_hierarchy.py geometry)
+_N = 4
+_SLICES = 2
+
+REPRESENTATIVE_CONFIGS = ("ddp", "zero1", "accum", "slices")
+
+# |parsed − analytic| / analytic tolerance: the formulas count gradient
+# + pmean payload; the compiled program adds a handful of scalar-sized
+# control collectives (same 2% bound tests/test_hierarchy.py locks)
+_ANALYTIC_RTOL = 0.02
+
+
+@dataclasses.dataclass(frozen=True)
+class BudgetViolation:
+    """One failed budget gate — formats to an actionable message."""
+
+    config: str
+    field: str
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"hlo-budget: {BUDGETS_FILENAME}: [{self.config}] "
+            f"{self.field}: {self.message} (if this comms/sharding "
+            f"change is INTENDED, re-commit the table with "
+            f"`dptpu check --update-hlo-budgets` and say why in the PR)"
+        )
+
+
+def _budget_model():
+    """The budget probe model — dense-heavy so every leaf scatters at
+    the 2/4-way geometries (the tests/test_hierarchy.py TinyDense
+    pattern), with BN for the replicated batch_stats pmean."""
+    from flax import linen as nn
+
+    class BudgetNet(nn.Module):
+        num_classes: int = 10
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(16, (3, 3), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             momentum=0.9)(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            x = nn.Dense(32)(x)
+            x = nn.relu(x)
+            return nn.Dense(self.num_classes)(x)
+
+    return BudgetNet()
+
+
+def _state():
+    import jax
+
+    from dptpu.train import create_train_state, make_optimizer
+
+    tx = make_optimizer(momentum=0.9, weight_decay=1e-4)
+    return create_train_state(
+        jax.random.PRNGKey(0), _budget_model(), tx,
+        input_shape=(1, 8, 8, 3),
+    )
+
+
+def _batch():
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    return {
+        "images": rng.randint(0, 256, (16, 8, 8, 3)).astype(np.uint8),
+        "labels": rng.randint(0, 10, (16,)).astype(np.int32),
+    }
+
+
+def _leaf_counts(state) -> dict:
+    import jax
+    import numpy as np
+
+    def total(tree):
+        return 4 * sum(
+            int(np.prod(l.shape)) if l.shape else 1
+            for l in jax.tree_util.tree_leaves(tree)
+        )
+
+    return {
+        "param_leaves": len(jax.tree_util.tree_leaves(state.params)),
+        "state_leaves": len(jax.tree_util.tree_leaves(state)),
+        # analytic payloads (fp32): gradient bytes, and the BN-stat +
+        # 3-scalar-metric pmean payload — tests/test_hierarchy.py's
+        # _grad_bytes/_pmean_bytes
+        "grad_bytes": total(state.params),
+        "pmean_bytes": total(state.batch_stats) + 4 * 3,
+    }
+
+
+def _compile_config(name: str) -> Tuple[str, dict]:
+    """Compiled HLO text + model facts for one representative config."""
+    import jax
+
+    from dptpu.parallel import (
+        make_hierarchical_mesh,
+        make_mesh,
+        make_zero1_train_step,
+        replicated_sharding,
+        shard_host_batch,
+        shard_zero1_state,
+    )
+    from dptpu.train import make_train_step
+
+    devices = jax.devices()[:_N]
+    if len(devices) < _N:
+        raise RuntimeError(
+            f"HLO budget gates need {_N} devices, got {len(devices)} — "
+            f"run under XLA_FLAGS=--xla_force_host_platform_device_"
+            f"count=8 (tests/conftest.py does this automatically)"
+        )
+    st = _state()
+    facts = _leaf_counts(st)
+    if name == "slices":
+        mesh = make_hierarchical_mesh(_SLICES, devices)
+        step = make_train_step(mesh)
+    elif name == "accum":
+        mesh = make_mesh(devices, {"data": _N})
+        step = make_train_step(mesh, accum_steps=2)
+    elif name == "zero1":
+        mesh = make_mesh(devices, {"data": _N})
+        step = make_zero1_train_step(mesh, st)
+    elif name == "ddp":
+        mesh = make_mesh(devices, {"data": _N})
+        step = make_train_step(mesh)
+    else:
+        raise ValueError(
+            f"unknown budget config {name!r} "
+            f"(representative set: {', '.join(REPRESENTATIVE_CONFIGS)})"
+        )
+    if name == "zero1":
+        st = shard_zero1_state(st, mesh)
+    else:
+        st = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, replicated_sharding(mesh)), st
+        )
+    batch = shard_host_batch(_batch(), mesh)
+    return step.lower(st, batch).compile().as_text(), facts
+
+
+def extract_budget(name: str) -> Tuple[dict, dict]:
+    """Parse one config's compiled program into its budget row."""
+    from dptpu.parallel.hlo_accounting import (
+        collective_bytes_by_link,
+        collective_bytes_per_chip,
+        donated_alias_count,
+        op_census,
+        parse_collectives,
+    )
+
+    txt, facts = _compile_config(name)
+    inner = _N // _SLICES
+    counts = {"all-gather": 0, "reduce-scatter": 0, "all-reduce": 0}
+    for inst in parse_collectives(txt):
+        counts[inst["op"]] += 1
+    row = {
+        "collective_instructions": counts,
+        "per_chip": collective_bytes_per_chip(txt, _N),
+        "alias_entries": donated_alias_count(txt),
+        "f64_shapes": op_census(txt)["f64_shapes"],
+    }
+    if name == "slices":
+        row["by_link"] = collective_bytes_by_link(
+            txt, lambda p: p // inner, _N
+        )
+    return row, facts
+
+
+def compute_budgets() -> dict:
+    """The full budget table (what ``--update-hlo-budgets`` commits)."""
+    configs = {}
+    facts = None
+    for name in REPRESENTATIVE_CONFIGS:
+        configs[name], facts = extract_budget(name)
+    return {
+        "version": 1,
+        "geometry": {"devices": _N, "slices": _SLICES,
+                     "inner": _N // _SLICES},
+        "model": facts,
+        "configs": configs,
+    }
+
+
+def load_budgets(root: str) -> Optional[dict]:
+    path = os.path.join(root, BUDGETS_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_budgets(root: str, budgets: dict) -> str:
+    path = os.path.join(root, BUDGETS_FILENAME)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(budgets, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def _analytic_violations(computed: dict) -> List[BudgetViolation]:
+    """The committed-table-independent half: the compiled programs must
+    reproduce the analytic formulas (so even a stale committed table
+    cannot bless a regression)."""
+    out = []
+    n, s = _N, _SLICES
+    inner = n // s
+    g = computed["model"]["grad_bytes"]
+    p = computed["model"]["pmean_bytes"]
+    cfg = computed["configs"]
+
+    def close(got, want):
+        return want > 0 and abs(got - want) / want < _ANALYTIC_RTOL
+
+    ddp = cfg["ddp"]["per_chip"]
+    if ddp["reduce-scatter"] or ddp["all-gather"]:
+        out.append(BudgetViolation(
+            "ddp", "per_chip",
+            f"flat DDP must emit ONLY all-reduce, got RS="
+            f"{ddp['reduce-scatter']} AG={ddp['all-gather']} bytes",
+        ))
+    want = 2 * (n - 1) / n * (g + p)
+    if not close(ddp["all-reduce"], want):
+        out.append(BudgetViolation(
+            "ddp", "per_chip.all-reduce",
+            f"{ddp['all-reduce']} bytes vs analytic 2(n-1)/n·(G+P) = "
+            f"{want:.0f} (r06 lock, tests/test_hierarchy.py)",
+        ))
+    z = cfg["zero1"]["per_chip"]["total"]
+    if not (ddp["total"] > 0
+            and abs(z - ddp["total"]) / ddp["total"] < 0.001):
+        out.append(BudgetViolation(
+            "zero1", "per_chip.total",
+            f"{z} bytes vs DDP's {ddp['total']} — ZeRO-1's AG+RS volume "
+            f"must equal the DDP all-reduce (the r06 equivalence)",
+        ))
+    if (cfg["accum"]["collective_instructions"]
+            != cfg["ddp"]["collective_instructions"]):
+        out.append(BudgetViolation(
+            "accum", "collective_instructions",
+            f"{cfg['accum']['collective_instructions']} vs DDP's "
+            f"{cfg['ddp']['collective_instructions']} — accumulation "
+            f"must keep ONE reduction per update, never per microbatch",
+        ))
+    link = cfg["slices"]["by_link"]
+    structural = (link["ici"]["all-reduce"] == 0
+                  and link["dcn"]["reduce-scatter"] == 0
+                  and link["dcn"]["all-gather"] == 0)
+    if not structural:
+        out.append(BudgetViolation(
+            "slices", "by_link",
+            "the hierarchical decomposition leaked: ICI must carry only "
+            "RS+AG and DCN only the shard-sized AR "
+            f"(got ici.AR={link['ici']['all-reduce']} "
+            f"dcn.RS={link['dcn']['reduce-scatter']} "
+            f"dcn.AG={link['dcn']['all-gather']})",
+        ))
+    want_ici = 2 * (inner - 1) / inner * g
+    want_dcn = (2 * (s - 1) / s * g / inner
+                + 2 * (n - 1) / n * p)
+    if not close(link["ici"]["total"], want_ici):
+        out.append(BudgetViolation(
+            "slices", "by_link.ici.total",
+            f"{link['ici']['total']} bytes vs analytic 2(I-1)/I·G = "
+            f"{want_ici:.0f}",
+        ))
+    if not close(link["dcn"]["total"], want_dcn):
+        out.append(BudgetViolation(
+            "slices", "by_link.dcn.total",
+            f"{link['dcn']['total']} bytes vs analytic "
+            f"2(S-1)/S·G/I + 2(n-1)/n·P = {want_dcn:.0f}",
+        ))
+    for name, row in cfg.items():
+        if row["f64_shapes"]:
+            out.append(BudgetViolation(
+                name, "f64_shapes",
+                f"{row['f64_shapes']} f64 shapes in the compiled "
+                f"program — an accidental double-precision promotion",
+            ))
+        if row["alias_entries"] < computed["model"]["param_leaves"]:
+            out.append(BudgetViolation(
+                name, "alias_entries",
+                f"input_output_alias covers {row['alias_entries']} "
+                f"buffers < {computed['model']['param_leaves']} param "
+                f"leaves — donation broke and the update now "
+                f"materializes a full-parameter copy",
+            ))
+    return out
+
+
+def check_hlo_budgets(
+    root: str, budgets: Optional[dict] = None,
+    computed: Optional[dict] = None,
+) -> Tuple[List[BudgetViolation], dict]:
+    """Run the gates. Returns (violations, computed_table). ``budgets``
+    overrides the committed table and ``computed`` a fresh compile —
+    the seeded-regression tests inject tampered tables through these
+    without paying four compiles per case."""
+    if computed is None:
+        computed = compute_budgets()
+    violations = _analytic_violations(computed)
+    committed = budgets if budgets is not None else load_budgets(root)
+    if committed is None:
+        violations.append(BudgetViolation(
+            "*", BUDGETS_FILENAME,
+            "no committed budget table — generate one with "
+            "`dptpu check --update-hlo-budgets`",
+        ))
+        return violations, computed
+    for name in REPRESENTATIVE_CONFIGS:
+        want = committed.get("configs", {}).get(name)
+        got = computed["configs"][name]
+        if want is None:
+            violations.append(BudgetViolation(
+                name, "configs",
+                "config missing from the committed table",
+            ))
+            continue
+        for field in ("collective_instructions", "per_chip", "by_link",
+                      "alias_entries", "f64_shapes"):
+            if field not in got and field not in want:
+                continue
+            if got.get(field) != want.get(field):
+                violations.append(BudgetViolation(
+                    name, field,
+                    f"compiled program changed: committed "
+                    f"{json.dumps(want.get(field), sort_keys=True)} "
+                    f"vs compiled "
+                    f"{json.dumps(got.get(field), sort_keys=True)}",
+                ))
+    return violations, computed
+
+
+def budget_summary(violations: List[BudgetViolation],
+                   computed: dict) -> Dict:
+    """The ANALYSIS.json block for the HLO half."""
+    return {
+        "ok": not violations,
+        "violations": [v.format() for v in violations],
+        "configs": computed["configs"],
+        "model": computed["model"],
+        "geometry": computed["geometry"],
+    }

@@ -1,0 +1,302 @@
+"""The AST lint engine behind ``dptpu check`` (stdlib-only, worker-safe).
+
+Mechanics, shared by every rule in :mod:`dptpu.analysis.rules`:
+
+* a rule is registered with :func:`register` and receives a
+  :class:`FileContext` (source, ``ast`` tree, repo-level context);
+  it yields ``(line, message)`` pairs;
+* findings are suppressible per line with the pragma
+  ``# dptpu: allow-<rule>(<reason>)`` — the reason is MANDATORY
+  (an empty reason, an unknown rule name, a malformed pragma, or a
+  pragma that suppresses nothing is itself a finding of the ``pragma``
+  meta-rule, which is deliberately not suppressible);
+* every finding formats to the locked actionable-message contract:
+  rule name, ``file:line``, the message, and the exact pragma syntax
+  that would suppress it (tests/test_analysis.py locks this).
+
+Import discipline: this module (and rules.py) must import NOTHING
+beyond the stdlib — the lint half of ``dptpu check`` runs inside
+spawned data workers and jax-free CI shards.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+PRAGMA_SYNTAX = "# dptpu: allow-<rule>(<reason>)"
+_PRAGMA_RE = re.compile(
+    r"#\s*dptpu:\s*allow-([A-Za-z0-9][A-Za-z0-9_-]*)\(([^()]*)\)"
+)
+# anything that says "dptpu:" in a comment but is not a well-formed
+# allow-pragma is flagged: a typo'd pragma silently suppressing nothing
+# is exactly the failure mode pragmas exist to avoid
+_PRAGMA_INTENT_RE = re.compile(r"#\s*dptpu:")
+
+# file sets scanned by lint_repo, relative to the repo root
+DEFAULT_SCAN_ROOTS = ("dptpu", "scripts")
+
+
+# meta-rules whose findings are deliberately NOT suppressible (a
+# pragma silencing pragma hygiene would be a hole in the hole-checker);
+# their messages must not advertise a pragma that cannot work
+UNSUPPRESSIBLE_RULES = ("pragma", "parse")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``format()`` is the locked message contract."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        head = f"{self.rule}: {self.path}:{self.line}: {self.message}"
+        if self.rule in UNSUPPRESSIBLE_RULES:
+            return f"{head} [not suppressible — fix the line itself]"
+        return (
+            f"{head} [suppress with a mandatory reason: "
+            f"# dptpu: allow-{self.rule}(<reason>)]"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    """A finding silenced by a reasoned pragma (censused, never lost)."""
+
+    rule: str
+    path: str
+    line: int
+    reason: str
+
+
+@dataclasses.dataclass
+class RepoContext:
+    """Repo-level facts rules may consult. ``readme_text=None`` (snippet
+    lints in unit tests) disables the README cross-checks."""
+
+    root: Optional[str] = None
+    readme_text: Optional[str] = None
+    knobs: Optional[dict] = None
+
+    @classmethod
+    def for_root(cls, root: str) -> "RepoContext":
+        from dptpu.analysis.knobs import KNOB_REGISTRY
+
+        readme = os.path.join(root, "README.md")
+        text = None
+        if os.path.exists(readme):
+            with open(readme, encoding="utf-8") as f:
+                text = f.read()
+        return cls(root=root, readme_text=text, knobs=KNOB_REGISTRY)
+
+
+@dataclasses.dataclass
+class FileContext:
+    relpath: str
+    source: str
+    tree: ast.AST
+    repo: RepoContext
+
+    _func_stack: Optional[Dict[int, Tuple[str, ...]]] = None
+    _module_consts: Optional[Dict[str, str]] = None
+
+    def enclosing_functions(self, node: ast.AST) -> Tuple[str, ...]:
+        """Names of the def/class scopes enclosing ``node`` (outermost
+        first) — how rules scope themselves to step bodies / the blessed
+        segment constructor / a specific class."""
+        if self._func_stack is None:
+            stack_of: Dict[int, Tuple[str, ...]] = {}
+
+            def visit(node, stack):
+                stack_of[id(node)] = stack
+                child_stack = stack
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    child_stack = stack + (node.name,)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, child_stack)
+
+            visit(self.tree, ())
+            self._func_stack = stack_of
+        return self._func_stack.get(id(node), ())
+
+    def resolve_str(self, node: ast.AST) -> Optional[str]:
+        """Static best-effort string value: a literal, or a Name bound
+        to a module-level string constant (``SEGMENT_PREFIX``-style)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if self._module_consts is None:
+                consts: Dict[str, str] = {}
+                for stmt in getattr(self.tree, "body", []):
+                    if (isinstance(stmt, ast.Assign)
+                            and isinstance(stmt.value, ast.Constant)
+                            and isinstance(stmt.value.value, str)):
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                consts[tgt.id] = stmt.value.value
+                self._module_consts = consts
+            return self._module_consts.get(node.id)
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    fn: Callable[[FileContext], Iterable[Tuple[int, str]]]
+    scope: Callable[[str], bool]
+    doc: str
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(name: str, scope: Callable[[str], bool], doc: str):
+    def deco(fn):
+        _RULES[name] = Rule(name, fn, scope, doc)
+        return fn
+
+    return deco
+
+
+def iter_rules() -> List[Rule]:
+    _load_rules()
+    return [_RULES[n] for n in sorted(_RULES)]
+
+
+def _load_rules():
+    # rules self-register on import; deferred so lint.py has no import
+    # cycle with rules.py
+    from dptpu.analysis import rules  # noqa: F401
+
+
+def _parse_pragmas(relpath: str, source: str):
+    """Per-line pragma table + the pragma meta-rule's own findings."""
+    pragmas: Dict[int, List[dict]] = {}
+    findings: List[Finding] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        matches = list(_PRAGMA_RE.finditer(text))
+        for m in matches:
+            rule_name, reason = m.group(1), m.group(2).strip()
+            entry = {"rule": rule_name, "reason": reason, "used": False}
+            if rule_name not in _RULES:
+                findings.append(Finding(
+                    "pragma", relpath, lineno,
+                    f"pragma names unknown rule {rule_name!r} (known: "
+                    f"{', '.join(sorted(_RULES))})",
+                ))
+                continue
+            if not reason:
+                findings.append(Finding(
+                    "pragma", relpath, lineno,
+                    f"pragma allow-{rule_name} has no reason — a reason "
+                    f"is mandatory: {PRAGMA_SYNTAX}",
+                ))
+                continue
+            pragmas.setdefault(lineno, []).append(entry)
+        if (_PRAGMA_INTENT_RE.search(text) and not matches
+                and "allow-<" not in text and "(<reason>)" not in text):
+            # lines quoting the SYNTAX itself (docstrings, the format
+            # string above) keep their placeholders; a real typo'd
+            # pragma has concrete text and still lands here
+            findings.append(Finding(
+                "pragma", relpath, lineno,
+                f"malformed dptpu pragma (would silently suppress "
+                f"nothing) — the syntax is {PRAGMA_SYNTAX}",
+            ))
+    return pragmas, findings
+
+
+def lint_source(
+    relpath: str,
+    source: str,
+    repo: Optional[RepoContext] = None,
+    only_rules: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], List[Suppression]]:
+    """Lint one file's source. Returns (findings, suppressions)."""
+    _load_rules()
+    repo = repo if repo is not None else RepoContext()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(
+            "parse", relpath, e.lineno or 1,
+            f"file does not parse: {e.msg}",
+        )], []
+    pragmas, findings = _parse_pragmas(relpath, source)
+    ctx = FileContext(relpath=relpath, source=source, tree=tree, repo=repo)
+    names = set(only_rules) if only_rules is not None else None
+    suppressions: List[Suppression] = []
+    for rule in iter_rules():
+        if names is not None and rule.name not in names:
+            continue
+        if not rule.scope(relpath):
+            continue
+        for line, message in rule.fn(ctx):
+            hit = next(
+                (p for p in pragmas.get(line, ())
+                 if p["rule"] == rule.name),
+                None,
+            )
+            if hit is not None:
+                hit["used"] = True
+                suppressions.append(
+                    Suppression(rule.name, relpath, line, hit["reason"])
+                )
+            else:
+                findings.append(Finding(rule.name, relpath, line, message))
+    for lineno, entries in pragmas.items():
+        for p in entries:
+            if not p["used"] and (names is None or p["rule"] in names):
+                findings.append(Finding(
+                    "pragma", relpath, lineno,
+                    f"unused pragma allow-{p['rule']} — nothing on this "
+                    f"line triggers that rule; remove the pragma",
+                ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, suppressions
+
+
+def lint_paths(
+    root: str, relpaths: Iterable[str], repo: Optional[RepoContext] = None
+) -> Tuple[List[Finding], List[Suppression]]:
+    repo = repo if repo is not None else RepoContext.for_root(root)
+    findings: List[Finding] = []
+    suppressions: List[Suppression] = []
+    for rel in relpaths:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            source = f.read()
+        got, sup = lint_source(rel, source, repo)
+        findings.extend(got)
+        suppressions.extend(sup)
+    return findings, suppressions
+
+
+def repo_python_files(root: str,
+                      scan_roots=DEFAULT_SCAN_ROOTS) -> List[str]:
+    """The repo's own lintable files: every ``.py`` under the scan
+    roots, repo-relative, sorted (deterministic reports)."""
+    out = []
+    for base in scan_roots:
+        basedir = os.path.join(root, base)
+        for dirpath, dirnames, filenames in os.walk(basedir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, name), root
+                    ))
+    return sorted(out)
+
+
+def lint_repo(root: str) -> Tuple[List[Finding], List[Suppression], int]:
+    """Lint the whole repo. Returns (findings, suppressions, n_files)."""
+    files = repo_python_files(root)
+    findings, suppressions = lint_paths(root, files)
+    return findings, suppressions, len(files)

@@ -1,0 +1,84 @@
+"""ANALYSIS.json — the machine-readable ``dptpu check`` report.
+
+Host-provenance-stamped like every other committed artifact
+(dptpu/utils/provenance.py), with the full suppression census: a waiver
+is never silent — every live ``# dptpu: allow-<rule>(<reason>)`` lands here
+with its file:line and reason, so the inventory of exceptions is
+reviewable in one place. The committed copy at the repo root is the
+baseline tier-1 asserts against (tests/test_analysis_repo.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from dptpu.analysis.knobs import knob_census
+from dptpu.analysis.lint import iter_rules, lint_repo
+
+REPORT_FILENAME = "ANALYSIS.json"
+
+
+def build_report(root: str, run_hlo: bool = True,
+                 budgets: Optional[dict] = None,
+                 computed: Optional[dict] = None) -> dict:
+    """Run the full check and assemble the report. ``report["ok"]`` is
+    the exit-code contract's single bit: True iff zero unsuppressed
+    lint findings AND (when run) zero HLO budget violations.
+    ``computed`` passes a fresh compile through to the budget gates
+    (``--update-hlo-budgets`` reuses its own compile instead of paying
+    four more)."""
+    findings, suppressions, n_files = lint_repo(root)
+    report = {
+        "version": 1,
+        "lint": {
+            "files_scanned": n_files,
+            "rules": {r.name: r.doc for r in iter_rules()},
+            "findings": [f.format() for f in findings],
+            "suppressions": sorted(
+                (dataclasses.asdict(s) for s in suppressions),
+                key=lambda s: (s["path"], s["line"], s["rule"]),
+            ),
+        },
+        "knobs": knob_census(),
+    }
+    ok = not findings
+    if run_hlo:
+        from dptpu.analysis.hlo_budget import (
+            budget_summary,
+            check_hlo_budgets,
+        )
+
+        violations, computed = check_hlo_budgets(
+            root, budgets=budgets, computed=computed
+        )
+        report["hlo"] = budget_summary(violations, computed)
+        ok = ok and not violations
+    else:
+        report["hlo"] = {"ok": None,
+                         "note": "skipped (--no-hlo lint-only run)"}
+    report["ok"] = ok
+    # stamped LAST so a full run records the jax the HLO gates actually
+    # loaded (and a lint-only run honestly records None — provenance
+    # reads sys.modules, it never imports jax itself)
+    from dptpu.utils.provenance import host_provenance
+
+    report["provenance"] = host_provenance()
+    return report
+
+
+def write_report(report: dict, path: str) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_report(root: str) -> Optional[dict]:
+    path = os.path.join(root, REPORT_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)

@@ -236,7 +236,7 @@ def main_pack(argv=None):
 
 
 def main(argv=None):
-    """The ``dptpu`` multi-command: ``dptpu serve|pack [...]``."""
+    """The ``dptpu`` multi-command: ``dptpu serve|pack|check [...]``."""
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
@@ -244,15 +244,22 @@ def main(argv=None):
         print("usage: dptpu <subcommand> [args]\n\nsubcommands:\n"
               "  serve   batched inference engine (dptpu/serve)\n"
               "  pack    ImageFolder -> packed sequential shards "
-              "(dptpu/data/shards.py)")
+              "(dptpu/data/shards.py)\n"
+              "  check   repo-invariant static analysis: AST lints + "
+              "HLO budget gates (dptpu/analysis)")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "serve":
         return main_serve(rest)
     if cmd == "pack":
         return main_pack(rest)
+    if cmd == "check":
+        from dptpu.analysis.cli import main_check
+
+        return main_check(rest)
     raise SystemExit(
-        f"dptpu: unknown subcommand {cmd!r} (available: serve, pack)"
+        f"dptpu: unknown subcommand {cmd!r} "
+        f"(available: serve, pack, check)"
     )
 
 

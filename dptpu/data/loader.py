@@ -706,7 +706,7 @@ class DevicePrefetcher:
             self._copy = jax.default_backend() == "cpu"
         if self._copy:
             with tracer.span("h2d"):
-                batch = {k: np.array(v) for k, v in batch.items()}
+                batch = {k: np.array(v) for k, v in batch.items()}  # dptpu: allow-host-sync(the documented CPU-backend defense: device_put zero-copy-aliases host buffers there, so recycling the slot would corrupt the in-flight batch — copy once, host to host)
                 out = self._put(batch)
             lease.release()
             return out
@@ -715,7 +715,7 @@ class DevicePrefetcher:
             # the H2D read must finish before the slot may be
             # overwritten; this wait overlaps the previous step's device
             # compute
-            jax.block_until_ready(out)
+            jax.block_until_ready(out)  # dptpu: allow-host-sync(H2D completion gate before the leased slot may be recycled; the wait overlaps the PREVIOUS step's device compute)
         lease.release()
         return out
 

@@ -231,7 +231,7 @@ class ShmDecodeCache:
         # keep "dptpu_cache", the shard BYTE cache (dptpu/data/store.py)
         # passes "dptpu_shard" so the conftest leak guard can tell them
         # apart
-        self._shm = create_named_segment(
+        self._shm = create_named_segment(  # dptpu: allow-shm-hygiene(prefix is caller-supplied: the decode cache passes dptpu_cache, the shard byte cache dptpu_shard — both census kinds; a new caller with a new prefix trips the census assert in tests/conftest.py)
             segment_prefix, meta_bytes + self.budget_bytes
         )
         self.segment_name = self._shm.name

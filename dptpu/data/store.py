@@ -44,7 +44,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from dptpu.envknob import env_float, env_int
+from dptpu.envknob import env_float, env_int, env_str
 
 _SCHEMES = ("http://", "https://", "file://")
 
@@ -88,10 +88,7 @@ _FAULT_CACHE = {"key": None, "plan": None}
 
 
 def _shared_fault_plan():
-    import os as _os
-
-    key = (_os.environ.get("DPTPU_FAULT", ""),
-           _os.environ.get("DPTPU_FAULT_SEED", ""))
+    key = (env_str("DPTPU_FAULT", ""), env_str("DPTPU_FAULT_SEED", ""))
     if _FAULT_CACHE["key"] != key:
         from dptpu.resilience.faults import FaultPlan
 

@@ -62,6 +62,18 @@ def env_choice(name: str, choices, default: Optional[str] = None,
     return raw
 
 
+def env_str(name: str, default: Optional[str] = None,
+            environ=None) -> Optional[str]:
+    """String env knob (paths, specs, sentinels); unset/empty →
+    ``default``. Any explicit value is legal — the helper exists so
+    free-form knobs still flow through ONE read point (the knob-contract
+    lint, dptpu/analysis, flags raw ``os.environ`` reads) and so their
+    names land in the declared registry + README like every other knob."""
+    raw = (environ if environ is not None else os.environ).get(
+        name, "").strip()
+    return raw if raw else default
+
+
 _TRUE = ("1", "true", "yes", "on")
 _FALSE = ("0", "false", "no", "off")
 

@@ -625,7 +625,9 @@ def _qkv_to_head_major(arch: str, variables):
 
 
 def weights_search_dirs():
-    env = os.environ.get("DPTPU_PRETRAINED_DIR")
+    from dptpu.envknob import env_str
+
+    env = env_str("DPTPU_PRETRAINED_DIR")
     return [env] if env else ["pretrained", "."]
 
 

@@ -43,7 +43,7 @@ from __future__ import annotations
 
 import os
 
-from dptpu.envknob import env_bool, env_float, env_int
+from dptpu.envknob import env_bool, env_float, env_int, env_str
 from dptpu.obs.metrics import (
     ConsoleSink,
     Counter,
@@ -147,8 +147,8 @@ def obs_knobs(environ=None) -> dict:
     return {
         "enabled": enabled,
         "ring": ring,
-        "dir": env.get("DPTPU_OBS_DIR", "").strip() or None,
+        "dir": env_str("DPTPU_OBS_DIR", None, environ=env),
         "trace_steps": trace_steps,
-        "trigger": env.get("DPTPU_OBS_TRIGGER", "").strip() or None,
+        "trigger": env_str("DPTPU_OBS_TRIGGER", None, environ=env),
         "anomaly": anomaly,
     }

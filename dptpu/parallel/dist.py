@@ -102,16 +102,13 @@ def initialize_distributed(cfg: Config) -> bool:
             )
         _initialized = (coordinator, world_size, rank)
         return True
-    timeout_s = os.environ.get("DPTPU_RENDEZVOUS_TIMEOUT")
-    try:
-        kwargs = (
-            {"initialization_timeout": int(timeout_s)} if timeout_s else {}
-        )
-    except ValueError:
-        raise ValueError(
-            f"DPTPU_RENDEZVOUS_TIMEOUT={timeout_s!r} must be a whole "
-            f"number of seconds (e.g. DPTPU_RENDEZVOUS_TIMEOUT=300)"
-        ) from None
+    from dptpu.envknob import env_int
+
+    timeout_s = env_int("DPTPU_RENDEZVOUS_TIMEOUT")
+    kwargs = (
+        {"initialization_timeout": timeout_s}
+        if timeout_s is not None else {}
+    )
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,

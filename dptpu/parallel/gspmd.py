@@ -264,7 +264,7 @@ def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
         # one logical program over the global batch: the shared step body
         # with no shard-local scaling or explicit collectives — the SPMD
         # partitioner derives all communication from the shardings
-        return train_step_body(
+        return train_step_body(  # dptpu: allow-shard-map(GSPMD is the one step with NO explicit axes: on_mesh=False, the SPMD partitioner derives every collective from the shardings)
             state, batch, compute_dtype=compute_dtype,
             lr_schedule=lr_schedule, seed=seed, axis_size=1, on_mesh=False,
             accum_steps=accum_steps, label_smoothing=label_smoothing,

@@ -187,6 +187,60 @@ def collective_bytes_by_link(
     return links
 
 
+_INSTR_RE = re.compile(
+    r"\s*(?:ROOT )?%?[\w.-]+ = (\S+?)\[([\d,]*)\][^ ]* (\w+)"
+)
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\(\d+")
+_F64_RE = re.compile(r"\bf64\[")
+
+
+def op_census(hlo_text: str) -> dict:
+    """Whole-program op-category census (the scripts/analyze_hlo.py
+    analysis, folded in here so the lint's HLO gates and the copy-storm
+    attribution can never diverge): per-opcode instruction counts,
+    copy ops bucketed by shape, every select-and-scatter line, and the
+    f64 shape-token count (the no-f64 gate — a single f64 anywhere in
+    the program means an accidental double-precision promotion)."""
+    import collections
+
+    ops = collections.Counter()
+    copy_shapes = collections.Counter()
+    sas_lines = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m:
+            dtype, shape, opname = m.groups()
+            ops[opname] += 1
+            if opname in ("copy", "copy-start", "copy-done"):
+                copy_shapes[f"{dtype}[{shape}]"] += 1
+        if "select-and-scatter" in line:
+            sas_lines.append(line.strip()[:200])
+    return {
+        "ops": dict(ops),
+        "copy_shapes": dict(copy_shapes),
+        "select_and_scatter": sas_lines,
+        "f64_shapes": len(_F64_RE.findall(hlo_text)),
+    }
+
+
+def donated_alias_count(hlo_text: str) -> int:
+    """Entries in the module's ``input_output_alias`` map — the
+    donation-honored gate's raw number. ``jit(..., donate_argnums=0)``
+    aliases every donated state leaf to its output slot; a refactor
+    that breaks donation (e.g. an output no longer shape-compatible
+    with its input) silently reintroduces a full-parameter copy in the
+    update, and this count is how the budget gate notices."""
+    # the map lives on the HloModule header line and nests bare {} pairs
+    # (empty shape indices), so scope the entry count to that line
+    # rather than bracket-matching
+    for line in hlo_text.splitlines():
+        if "input_output_alias=" in line:
+            return len(_ALIAS_ENTRY_RE.findall(
+                line.split("input_output_alias=", 1)[1]
+            ))
+    return 0
+
+
 def preopt_hlo_text(lowered) -> str:
     """Pre-optimization HLO text from a ``jax.jit(...).lower(...)``
     result — where a requested bf16 wire dtype is still visible on

@@ -69,7 +69,7 @@ import signal
 import time
 from typing import Callable, Optional
 
-from dptpu.envknob import env_int
+from dptpu.envknob import env_int, env_str
 
 _KINDS = ("sigterm", "worker_kill", "ckpt_truncate", "io_error",
           "worker_hang", "sigterm_one_host", "host_lost", "slow_host")
@@ -180,8 +180,7 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["FaultPlan"]:
-        environ = environ if environ is not None else os.environ
-        spec = environ.get("DPTPU_FAULT", "").strip()
+        spec = env_str("DPTPU_FAULT", "", environ=environ)
         if not spec:
             return None
         return cls(spec, seed=env_int("DPTPU_FAULT_SEED", 0, environ))

@@ -206,7 +206,7 @@ class QuorumCoordinator:
         if self.store.get(self._key("stop")) is None:
             self.store.put(self._key("stop"), json.dumps({
                 "reason": reason, "host": self.host_id, "step": int(step),
-                "ts": time.time(),
+                "ts": time.time(),  # dptpu: allow-determinism(stop-record timestamp is operator telemetry; replay keys on step, never on ts)
             }))
 
     def pending_stop(self) -> Optional[dict]:
@@ -220,7 +220,7 @@ class QuorumCoordinator:
 
     def post_ready(self, step: int):
         self.store.put(self._key(f"ready-{self.host_id}"), json.dumps({
-            "step": int(step), "ts": time.time(),
+            "step": int(step), "ts": time.time(),  # dptpu: allow-determinism(ready-record timestamp is telemetry; the quorum agrees on the max ready STEP, never on ts)
         }))
 
     def ready_steps(self) -> Dict[int, int]:
@@ -253,7 +253,7 @@ class QuorumCoordinator:
         save knowing no host joins the collective alone."""
         timeout_s = self.deadline_s if timeout_s is None else timeout_s
         self.store.put(self._key(f"barrier-{tag}-{self.host_id}"),
-                       json.dumps({"ts": time.time()}))
+                       json.dumps({"ts": time.time()}))  # dptpu: allow-determinism(barrier arrival stamp is telemetry; the barrier itself runs on monotonic deadlines)
         deadline = time.monotonic() + timeout_s
         while True:
             present = sum(
@@ -271,7 +271,7 @@ class QuorumCoordinator:
 
     def heartbeat(self, step: int):
         self.store.put(f"beat-{self.host_id}", json.dumps({
-            "step": int(step), "ts": time.time(),
+            "step": int(step), "ts": time.time(),  # dptpu: allow-determinism(heartbeat liveness IS wall-clock by design — staleness ages out by real elapsed time)
         }))
 
     def missing_hosts(self, timeout_s: Optional[float] = None) -> list:
@@ -279,7 +279,7 @@ class QuorumCoordinator:
         "gone for good" input that ultimately triggers elastic resume
         (a host that never beat at all counts as missing too)."""
         timeout_s = self.deadline_s if timeout_s is None else timeout_s
-        now = time.time()
+        now = time.time()  # dptpu: allow-determinism(liveness aging compares heartbeat wall-clock stamps; no replayed value derives from it)
         gone = []
         for h in range(self.num_hosts):
             raw = self.store.get(f"beat-{h}")

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Sequence, Tuple
 
-from dptpu.envknob import env_choice, env_float, env_int
+from dptpu.envknob import env_choice, env_float, env_int, env_str
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
 DEFAULT_MAX_DELAY_MS = 5.0
@@ -93,7 +93,7 @@ def serve_knobs(buckets: Optional[Sequence[int]] = None,
     import os
 
     env = environ if environ is not None else os.environ
-    raw_buckets = env.get("DPTPU_SERVE_BUCKETS", "").strip()
+    raw_buckets = env_str("DPTPU_SERVE_BUCKETS", "", environ=env)
     if raw_buckets:
         out_buckets = parse_buckets(raw_buckets)
     elif buckets is not None:

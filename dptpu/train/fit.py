@@ -63,9 +63,13 @@ from dptpu.train.step import make_eval_step, make_train_step
 
 
 def _os_environ_flag(name: str) -> bool:
-    import os
+    """Boolean env knob under the fail-fast contract (dptpu/envknob.py):
+    unset/empty → False, junk raises actionably — DPTPU_ZERO1=flase must
+    never silently mean 'off' (the knob-contract lint, dptpu/analysis,
+    polices that no raw os.environ read can reintroduce the fallback)."""
+    from dptpu.envknob import env_bool
 
-    return os.environ.get(name, "").lower() in ("1", "true", "yes")
+    return bool(env_bool(name, False))
 
 
 def _os_environ_int(name: str):
@@ -347,14 +351,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # ring attention (DPTPU_SP_MODE, default ulysses). ViT-only: Swin's
     # windowed attention is already local and parallelizes spatially via
     # the data axis (README); CNNs have no token axis at all.
-    import os as _os_sp
+    from dptpu.envknob import env_choice
 
     sp_n = _axis_env_knob("DPTPU_SP", "seq-axis size")
-    sp_mode = _os_sp.environ.get("DPTPU_SP_MODE", "ulysses")
-    if sp_n > 1 and sp_mode not in ("ulysses", "ring"):
-        raise ValueError(
-            f"DPTPU_SP_MODE={sp_mode!r} must be 'ulysses' or 'ring'"
-        )
+    # fail-fast even when SP is off: a typo'd mode must not sit silently
+    # in the environment waiting for the day DPTPU_SP is turned on
+    sp_mode = env_choice("DPTPU_SP_MODE", ("ulysses", "ring"), "ulysses")
     if sp_n == 1 and verbose:
         print("=> DPTPU_SP=1 is a no-op: a one-way seq axis is just "
               "data parallelism")
@@ -1057,9 +1059,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # structured tracing (SURVEY.md §5: the reference has only wall-clock
     # meters; dptpu adds an opt-in XLA profile): DPTPU_PROFILE=<dir> traces
     # the first training epoch into a TensorBoard-viewable profile.
-    import os as _os
+    from dptpu.envknob import env_str
 
-    profile_dir = _os.environ.get("DPTPU_PROFILE")
+    profile_dir = env_str("DPTPU_PROFILE")
     if profile_dir and derived.is_chief:
         jax.profiler.start_trace(profile_dir)
 
@@ -1135,7 +1137,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # plain PreemptionGuard path at the identical save position.
     from dptpu.resilience.quorum import QuorumSession, make_coordinator
 
-    _quorum_dir = os.environ.get("DPTPU_QUORUM_DIR", "").strip() or None
+    from dptpu.envknob import env_str as _env_str
+
+    _quorum_dir = _env_str("DPTPU_QUORUM_DIR")
     _coord = make_coordinator(
         derived.num_processes, derived.process_index,
         el_conf["quorum_deadline_s"], directory=_quorum_dir,

@@ -124,7 +124,8 @@ def train_one_epoch(
                 cut = max(len(pending) - lag, 0)
                 ready, pending = pending[:cut], pending[cut:]
                 t_fetch = pc()
-                for m, nb in jax.device_get([(p[0], p[1]) for p in ready]):
+                for m, nb in jax.device_get(  # dptpu: allow-host-sync(the ONE lagged sync per print interval — the documented buffered-fetch design; the newest 2 steps stay in flight)
+                        [(p[0], p[1]) for p in ready]):
                     losses.update(float(m["loss"]), nb)
                     top1.update(float(m["top1"]), nb)
                     top5.update(float(m["top5"]), nb)
@@ -173,7 +174,7 @@ def train_one_epoch(
                 pass
         raise
     t_fetch = pc()
-    for m, nb in jax.device_get(pending):
+    for m, nb in jax.device_get(pending):  # dptpu: allow-host-sync(epoch-tail drain: the last un-fetched steps sync once, after the loop)
         losses.update(float(m["loss"]), nb)
         top1.update(float(m["top1"]), nb)
         top5.update(float(m["top5"]), nb)
@@ -253,7 +254,7 @@ def validate(
             progress.display(i)
     totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
     t_fetch = pc()
-    for sums in jax.device_get(device_sums):
+    for sums in jax.device_get(device_sums):  # dptpu: allow-host-sync(validation's single final sync — the Apex sharded-val behavior without its per-step stall)
         for k in totals:
             totals[k] += float(sums[k])
     if device_sums:

@@ -91,9 +91,9 @@ def tpu_compiler_options() -> Optional[dict]:
 
     ``DPTPU_NO_LHS=1`` opts out (debugging/regression triage).
     """
-    import os
+    from dptpu.envknob import env_bool
 
-    if jax.default_backend() != "tpu" or os.environ.get("DPTPU_NO_LHS"):
+    if jax.default_backend() != "tpu" or env_bool("DPTPU_NO_LHS", False):
         return None
     return {"xla_tpu_enable_latency_hiding_scheduler": "true"}
 

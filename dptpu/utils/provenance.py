@@ -1,0 +1,43 @@
+"""Host provenance — the fingerprint every committed artifact carries.
+
+Moved here from scripts/bench_util.py (which re-exports it) so the
+static-analysis report (ANALYSIS.json, dptpu/analysis/report.py) can
+stamp itself the way every bench artifact does without importing the
+scripts tree: ROADMAP's standing caveat — "every number since r6 is
+from a throttled 2-core host" — stays a machine-readable field, and
+automated comparisons can refuse to diff artifacts from different host
+classes.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+
+def host_provenance() -> dict:
+    """The host fingerprint every committed artifact carries: CPU
+    budget, platform triple, interpreter and jax/XLA versions. Cheap,
+    pure, and safe to call before OR after jax initializes a backend.
+    The jax version is read from ``sys.modules`` WITHOUT importing jax:
+    a lint-only ``dptpu check --no-hlo`` run (or a spawned data worker)
+    must stay genuinely jax-free — every caller that benches jax code
+    has already imported it, so the field is still populated wherever
+    it is meaningful (``None`` = the stamping process never loaded
+    jax)."""
+    jax_version = getattr(sys.modules.get("jax"), "__version__", None)
+    affinity = None
+    if hasattr(os, "sched_getaffinity"):
+        try:
+            affinity = len(os.sched_getaffinity(0))
+        except OSError:
+            affinity = None
+    return {
+        "cpu_count": os.cpu_count(),
+        "cpu_affinity": affinity,
+        "platform": platform.platform(),
+        "machine": platform.machine(),
+        "python": sys.version.split()[0],
+        "jax": jax_version,
+    }

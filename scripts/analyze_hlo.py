@@ -1,19 +1,23 @@
 #!/usr/bin/env python3
 """Dump + analyze the compiled HLO of the bench train step.
 
-Counts op categories (copies, select_and_scatter, fusions) and buckets the
-copy ops by shape so the copy storm (PERF.md) can be attributed to real
-parameters rather than guessed at.
+Thin CLI over ``dptpu.parallel.hlo_accounting.op_census`` — ONE parser
+serves this attribution tool, the SCALEBENCH/COMMBENCH byte accounting,
+and ``dptpu check``'s HLO budget gates (ISSUE 12: a second copy of the
+HLO math would let a bench and its regression lock silently diverge).
+Counts op categories (copies, select_and_scatter, fusions) and buckets
+the copy ops by shape so the copy storm (PERF.md) can be attributed to
+real parameters rather than guessed at.
 """
 
-import collections
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+from dptpu.parallel.hlo_accounting import op_census  # noqa: E402
 
 
 def main():
@@ -45,26 +49,18 @@ def main():
     with open("/tmp/step_hlo.txt", "w") as f:
         f.write(text)
 
-    ops = collections.Counter()
-    copy_shapes = collections.Counter()
-    for line in text.splitlines():
-        m = re.match(r"\s*(?:ROOT )?%?[\w.-]+ = (\S+?)\[([\d,]*)\][^ ]* (\w+)", line)
-        if not m:
-            continue
-        dtype, shape, opname = m.groups()
-        ops[opname] += 1
-        if opname in ("copy", "copy-start", "copy-done"):
-            copy_shapes[f"{dtype}[{shape}]"] += 1
+    census = op_census(text)
     print("== op counts (top 30) ==")
-    for op, n in ops.most_common(30):
+    for op, n in sorted(census["ops"].items(), key=lambda kv: -kv[1])[:30]:
         print(f"  {op:30s} {n}")
     print("== copy shapes ==")
-    for s, n in copy_shapes.most_common(40):
+    for s, n in sorted(census["copy_shapes"].items(),
+                       key=lambda kv: -kv[1])[:40]:
         print(f"  {s:40s} {n}")
     print("select_and_scatter lines:")
-    for line in text.splitlines():
-        if "select-and-scatter" in line:
-            print("  " + line.strip()[:200])
+    for line in census["select_and_scatter"]:
+        print("  " + line)
+    print("f64 shape tokens:", census["f64_shapes"])
     # memory analysis
     mem = compiled.memory_analysis()
     print("memory:", mem)

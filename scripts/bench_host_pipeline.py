@@ -63,6 +63,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dptpu.envknob import env_str  # noqa: E402
+
 import numpy as np
 
 
@@ -350,7 +352,7 @@ def ring_sweep(train_root, args, results, cores):
                   f"{ab[name]['interval_p90_ms']:.0f} ms, max "
                   f"{ab[name]['interval_max_ms']:.0f} ms, reissues "
                   f"{ab[name]['straggler_reissues']}")
-        ab["fault"] = os.environ["DPTPU_FAULT"]
+        ab["fault"] = env_str("DPTPU_FAULT", "")
         ab["note"] = (
             "one injected straggler per epoch (worker 0 sleeps "
             f"{args.straggler_s}s on one sample); intervals over "

@@ -6,14 +6,16 @@ number since r6 is from a throttled 2-core host" — becomes a
 machine-readable field instead of prose, so a future reader (or a
 re-run on a real TPU box) can tell at a glance which hardware produced
 which number, and automated comparisons can refuse to diff artifacts
-from different host classes.
+from different host classes. The implementation now lives in
+``dptpu.utils.provenance`` (ANALYSIS.json stamps itself the same way);
+this re-export keeps every ``run_*bench.py`` import working.
 """
 
 from __future__ import annotations
 
 import os
-import platform
-import sys
+
+from dptpu.utils.provenance import host_provenance  # noqa: F401
 
 
 def make_jpeg_imagefolder(root: str, n_images: int, n_classes: int = 2,
@@ -37,31 +39,3 @@ def make_jpeg_imagefolder(root: str, n_images: int, n_classes: int = 2,
             noise = rng.randint(0, 255, (low[1], low[0], 3), np.uint8)
             img = Image.fromarray(noise).resize(px, Image.BILINEAR)
             img.save(os.path.join(d, f"{i}.jpg"), quality=quality)
-
-
-def host_provenance() -> dict:
-    """The host fingerprint every bench artifact carries: CPU budget,
-    platform triple, interpreter and jax/XLA versions. Cheap, pure,
-    and safe to call before OR after jax initializes a backend."""
-    try:
-        import jax
-
-        jax_version = jax.__version__
-        # backend platform only if already initialized elsewhere is
-        # irrelevant here: benches record their own platform field
-    except Exception:  # jax-less callers (pure host benches)
-        jax_version = None
-    affinity = None
-    if hasattr(os, "sched_getaffinity"):
-        try:
-            affinity = len(os.sched_getaffinity(0))
-        except OSError:
-            affinity = None
-    return {
-        "cpu_count": os.cpu_count(),
-        "cpu_affinity": affinity,
-        "platform": platform.platform(),
-        "machine": platform.machine(),
-        "python": sys.version.split()[0],
-        "jax": jax_version,
-    }

@@ -49,6 +49,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dptpu.envknob import env_str  # noqa: E402
+
 import numpy as np
 
 _CHILD_ENV = "DPTPU_COMMBENCH_CHILD"
@@ -80,7 +82,7 @@ def _ensure_cpu_pool(n: int):
 
     import jax
 
-    if os.environ.get(_CHILD_ENV):
+    if env_str(_CHILD_ENV):
         if jax.device_count() < n:
             raise RuntimeError(
                 f"re-exec'd child still sees {jax.device_count()} "

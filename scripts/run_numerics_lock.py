@@ -34,6 +34,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dptpu.envknob import env_str  # noqa: E402
+
 import numpy as np
 
 STEPS = 20
@@ -70,7 +72,7 @@ def trajectory(dtype_name: str):
 
 
 def main():
-    if os.environ.get("DPTPU_NUMERICS_CHILD"):
+    if env_str("DPTPU_NUMERICS_CHILD"):
         # env JAX_PLATFORMS is latched to the TPU plugin by this image's
         # sitecustomize (it imports jax at interpreter startup); the
         # config update still works because the PJRT client is created

@@ -45,6 +45,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dptpu.envknob import env_str  # noqa: E402
+
 import numpy as np
 
 _CHILD_ENV = "DPTPU_SCALEBENCH_CHILD"
@@ -58,7 +60,7 @@ def _ensure_cpu_pool(n: int):
 
     import jax
 
-    if os.environ.get(_CHILD_ENV):
+    if env_str(_CHILD_ENV):
         # the env vars below only work if they beat the backend latch;
         # verify instead of trusting (same failure _force_cpu_devices
         # diagnoses for the dryrun child)

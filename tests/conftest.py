@@ -74,6 +74,11 @@ _FAST_MODULES = {
     # straggler chaos gates MUST hold in tier 1 (one subprocess,
     # --smoke preset, same gates as FAULTBENCH.json)
     "test_elastic", "test_faultbench_smoke",
+    # static analysis (PR 12): the lint units are pure stdlib; the
+    # repo gate compiles only the four TinyDense-sized budget configs
+    # (the test_hierarchy precedent, cached module-wide) — the
+    # zero-findings + HLO-budget acceptance bars MUST hold in tier 1
+    "test_analysis", "test_analysis_repo",
 }
 
 
