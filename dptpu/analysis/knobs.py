@@ -28,6 +28,9 @@ KNOB_REGISTRY = {
     "DPTPU_OPT": _k("choice", "train"),
     "DPTPU_ACCUM": _k("int", "train"),
     "DPTPU_WARMUP_EPOCHS": _k("int", "train"),
+    "DPTPU_WARMUP_POLY": _k("float", "train"),
+    "DPTPU_BATCH_RAMP": _k("str", "train"),
+    "DPTPU_DIST_EVAL": _k("bool", "train"),
     "DPTPU_LABEL_SMOOTH": _k("float", "train"),
     "DPTPU_FUSED_STEM": _k("bool", "train"),
     "DPTPU_S2D": _k("bool", "train"),
@@ -43,6 +46,8 @@ KNOB_REGISTRY = {
     "DPTPU_GSPMD": _k("bool", "parallel"),
     "DPTPU_SLICES": _k("int", "parallel"),
     "DPTPU_DCN_DTYPE": _k("choice", "parallel"),
+    "DPTPU_OVERLAP": _k("bool", "parallel"),
+    "DPTPU_BUCKET_MB": _k("float", "parallel"),
     "DPTPU_RENDEZVOUS_TIMEOUT": _k("int", "parallel"),
     # data plane
     "DPTPU_WORKERS_MODE": _k("choice", "data"),
@@ -88,6 +93,7 @@ KNOB_REGISTRY = {
     "DPTPU_NUMERICS_CHILD": _k("str", "bench", internal=True),
     "DPTPU_SCALEBENCH_CHILD": _k("str", "bench", internal=True),
     "DPTPU_COMMBENCH_CHILD": _k("str", "bench", internal=True),
+    "DPTPU_RACEBENCH_CHILD": _k("str", "bench", internal=True),
 }
 
 

@@ -68,7 +68,7 @@ def make_step_decay_schedule(base_lr, steps_per_epoch):
 
 
 def make_warmup_cosine_schedule(base_lr, steps_per_epoch, total_epochs,
-                                warmup_epochs, end_lr=0.0):
+                                warmup_epochs, end_lr=0.0, power=1.0):
     """Traced large-batch schedule: linear warmup to ``base_lr`` over
     ``warmup_epochs``, then cosine decay to ``end_lr`` over the rest.
 
@@ -76,6 +76,13 @@ def make_warmup_cosine_schedule(base_lr, steps_per_epoch, total_epochs,
     takes a nonzero LR — ``base_lr / warmup_steps`` — so no step is
     wasted at exactly 0). A pure function of the global step count, so
     resume lands on the exact LR like every other dptpu schedule.
+
+    ``power`` != 1 bends the warmup into the POLYNOMIAL ramp of the
+    extreme-scale recipes (``DPTPU_WARMUP_POLY``; Mikami et al.,
+    arXiv:1811.05233 warm up as ``(t/T_w)^p`` — a gentler start for the
+    very large batches where even the linear ramp's first steps
+    overshoot). ``power == 1.0`` keeps today's exact linear expression
+    (bit-identical: the power path is never traced).
     """
     import jax.numpy as jnp
 
@@ -84,7 +91,12 @@ def make_warmup_cosine_schedule(base_lr, steps_per_epoch, total_epochs,
 
     def schedule(count):
         count = jnp.asarray(count).astype(jnp.float32)
-        warm = base_lr * (count + 1.0) / warmup_steps
+        if power == 1.0:
+            warm = base_lr * (count + 1.0) / warmup_steps
+        else:
+            warm = base_lr * jnp.power(
+                (count + 1.0) / warmup_steps, power
+            )
         frac = jnp.clip(
             (count - warmup_steps) / (total_steps - warmup_steps), 0.0, 1.0
         )
@@ -92,6 +104,111 @@ def make_warmup_cosine_schedule(base_lr, steps_per_epoch, total_epochs,
             1.0 + jnp.cos(jnp.pi * frac)
         )
         return jnp.where(count < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def parse_batch_ramp(spec):
+    """Parse ``DPTPU_BATCH_RAMP`` — the batch-size ramp of the
+    extreme-scale recipes (arXiv:1811.05233 §3.1: start small while the
+    loss surface is steep, grow the batch as training stabilizes).
+
+    Format: ``"epoch:mult[,epoch:mult...]"`` — from ``epoch`` onward the
+    per-host batch is ``mult ×`` the configured ``--batch-size`` (and
+    the schedule's peak LR scales ``× mult`` per the linear-scaling
+    rule). Epochs must be non-negative ints, strictly increasing;
+    multipliers positive ints. A leading ``(0, 1)`` phase is implied
+    when the spec does not name epoch 0. Raises actionably on any
+    malformed spec (the locked fail-fast knob contract).
+    """
+    pairs = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        epoch_s, sep, mult_s = part.partition(":")
+        try:
+            if not sep:
+                raise ValueError
+            epoch, mult = int(epoch_s), int(mult_s)
+        except ValueError:
+            raise ValueError(
+                f"DPTPU_BATCH_RAMP entry {part!r} is not 'epoch:mult' "
+                f"(expected e.g. DPTPU_BATCH_RAMP=4:2,8:4)"
+            ) from None
+        if epoch < 0 or mult < 1:
+            raise ValueError(
+                f"DPTPU_BATCH_RAMP entry {part!r}: epoch must be >= 0 "
+                f"and mult >= 1"
+            )
+        pairs.append((epoch, mult))
+    if not pairs:
+        raise ValueError(
+            "DPTPU_BATCH_RAMP is set but holds no 'epoch:mult' entries "
+            "(expected e.g. DPTPU_BATCH_RAMP=4:2,8:4)"
+        )
+    epochs = [e for e, _ in pairs]
+    if sorted(set(epochs)) != epochs:
+        raise ValueError(
+            f"DPTPU_BATCH_RAMP epochs must be strictly increasing, got "
+            f"{epochs}"
+        )
+    if pairs[0][0] != 0:
+        pairs.insert(0, (0, 1))
+    return pairs
+
+
+def ramp_multiplier(ramp, epoch: int) -> int:
+    """The batch multiplier in force at ``epoch`` (a step function of
+    the parsed ramp table — the LAST phase whose start is <= epoch)."""
+    mult = 1
+    for e, m in ramp:
+        if epoch >= e:
+            mult = m
+    return mult
+
+
+def ramp_phase_start(ramp, epoch: int) -> int:
+    """The start epoch of the phase containing ``epoch`` (the LR
+    schedule's anchor: together with the cumulative step count at that
+    boundary it makes the phase schedule a pure function of the global
+    step, so resume lands on the exact LR)."""
+    start = 0
+    for e, _m in ramp:
+        if epoch >= e:
+            start = e
+    return start
+
+
+def make_ramp_phase_schedule(peak_lr, steps_per_epoch, total_epochs,
+                             warmup_epochs, epoch0, step0, end_lr=0.0,
+                             power=1.0):
+    """The warmup→cosine schedule for ONE batch-ramp phase, expressed
+    in fractional epochs so phases with different ``steps_per_epoch``
+    chain continuously: ``epoch(count) = epoch0 + (count - step0) /
+    steps_per_epoch`` with ``(epoch0, step0)`` the phase-start anchor
+    (both derivable from the ramp table alone, so a resumed run
+    reconstructs the identical schedule). ``peak_lr`` already carries
+    the phase's linear-scaling factor; ``power`` is the polynomial
+    warmup exponent (1 = linear)."""
+    import jax.numpy as jnp
+
+    warmup_e = float(max(warmup_epochs, 1e-9))
+    total_e = float(max(total_epochs, warmup_epochs + 1e-6))
+
+    def schedule(count):
+        count = jnp.asarray(count).astype(jnp.float32)
+        # 1-based within the phase, like the non-ramp warmup
+        e1 = epoch0 + (count - step0 + 1.0) / steps_per_epoch
+        e = epoch0 + (count - step0) / steps_per_epoch
+        warm = peak_lr * jnp.power(
+            jnp.clip(e1 / warmup_e, 0.0, 1.0), power
+        )
+        frac = jnp.clip((e - warmup_e) / (total_e - warmup_e), 0.0, 1.0)
+        cos = end_lr + (peak_lr - end_lr) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac)
+        )
+        return jnp.where(e1 < warmup_e, warm, cos)
 
     return schedule
 

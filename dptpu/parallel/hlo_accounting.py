@@ -241,6 +241,161 @@ def donated_alias_count(hlo_text: str) -> int:
     return 0
 
 
+# opcodes that represent real math in the scheduled entry computation —
+# the "backward computation" the overlap evidence counts between
+# reduction collectives (fusions cover almost everything post-fusion;
+# convolution/dot are the unfused gemms, while the scan loop,
+# custom-call the top-k kernel)
+_COMPUTE_OPCODES = frozenset((
+    "fusion", "convolution", "dot", "while", "reduce", "reduce-window",
+    "select-and-scatter", "custom-call", "call", "scatter", "sort",
+))
+
+
+def _entry_opcode(line: str) -> Optional[str]:
+    """Opcode of one entry-computation instruction line, handling tuple
+    result types (``%t = (f32[2], f32[3]) tuple(...)``) whose parens
+    defeat a naive token split."""
+    eq = line.find(" = ")
+    if eq < 0:
+        return None
+    rest = line[eq + 3:].lstrip()
+    if rest.startswith("("):
+        depth = 0
+        for k, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    rest = rest[k + 1:].lstrip()
+                    break
+        else:
+            return None
+    else:
+        parts = rest.split(None, 1)
+        if len(parts) < 2:
+            return None
+        rest = parts[1]
+    m = re.match(r"([\w-]+)\(", rest)
+    return m.group(1) if m else None
+
+
+def overlap_evidence(hlo_text: str, min_bytes: int = 256) -> dict:
+    """Evidence that gradient reductions overlap backward compute in
+    the COMPILED SCHEDULE (``compile().as_text()`` prints the scheduled
+    module — instruction order IS execution order per stream).
+
+    A "reduction" is an ``all-reduce``/``reduce-scatter`` instruction
+    (sync, or the async ``-start`` half) whose result payload is at
+    least ``min_bytes`` — the gradient/bucket collectives; the scalar
+    metric psums and tiny BN-stat pmeans fall below the bar.  Evidence:
+
+    * ``reductions`` — how many such instructions the entry holds
+      (bucketed programs: one per bucket per hop; the monolithic fused
+      form would show 1);
+    * ``interleaved_gaps`` — adjacent reduction pairs with >= 1 compute
+      instruction (fusion/conv/dot/while/...) scheduled between them:
+      > 0 means the collectives are NOT one contiguous post-backward
+      block;
+    * ``compute_between`` — total compute instructions between the
+      first and last reduction (the work available to hide them);
+    * ``async_pairs`` / ``async_compute_between`` — on backends that
+      emit ``-start``/``-done`` pairs (XLA:TPU), how many pairs exist
+      and how much compute is scheduled inside each window — the
+      DIRECT overlap statement.  This CPU backend emits synchronous
+      collectives, so here the schedule-interleaving numbers are the
+      evidence (the honesty note in PARALLELISM.md).
+
+    The ``dptpu check`` overlap gates assert ``reductions >= 2`` and
+    ``interleaved_gaps >= 1`` for the overlap budget configs.
+    """
+    seq: List[dict] = []
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if not in_entry:
+            continue
+        if line.startswith("}"):
+            break
+        if not re.match(r"\s*(?:ROOT )?%?[\w.-]+ = ", line):
+            continue
+        entry = {"kind": "other", "name": None, "start_ref": None}
+        mc = _OP_RE.search(line)
+        if mc:
+            result_part, op, suffix = mc.groups()
+            payload = 0
+            shapes = []
+            for dt, dims in _SHAPE_RE.findall(result_part):
+                size = 1
+                for d in dims.split(","):
+                    if d:
+                        size *= int(d)
+                shapes.append(size * _ITEMSIZE.get(dt, 4))
+            if suffix == "-start" and len(shapes) > 1:
+                shapes = shapes[len(shapes) // 2:]
+            payload = sum(shapes)
+            nm = re.match(r"\s*(?:ROOT )?%?([\w.-]+) = ", line)
+            entry["name"] = nm.group(1) if nm else None
+            if op in ("all-reduce", "reduce-scatter") \
+                    and payload >= min_bytes:
+                if suffix == "-done":
+                    entry["kind"] = "red_done"
+                    # the done's operand is the start instruction: the
+                    # last %name closing a paren on the line (the
+                    # result type's nested tuple parens carry no names)
+                    ref = re.findall(r"%([\w.-]+)\)", line)
+                    entry["start_ref"] = ref[-1] if ref else None
+                else:
+                    entry["kind"] = (
+                        "red_start" if suffix == "-start" else "red"
+                    )
+            elif suffix == "-done":
+                entry["kind"] = "coll"
+            else:
+                entry["kind"] = "coll"
+        else:
+            op = _entry_opcode(line)
+            if op in _COMPUTE_OPCODES:
+                entry["kind"] = "compute"
+        seq.append(entry)
+
+    red_pos = [i for i, e in enumerate(seq)
+               if e["kind"] in ("red", "red_start")]
+    compute_pos = [i for i, e in enumerate(seq) if e["kind"] == "compute"]
+    interleaved = 0
+    for a, b in zip(red_pos, red_pos[1:]):
+        if any(a < c < b for c in compute_pos):
+            interleaved += 1
+    between = (
+        sum(1 for c in compute_pos if red_pos[0] < c < red_pos[-1])
+        if len(red_pos) >= 2 else 0
+    )
+    # async start/done windows: compute scheduled while the collective
+    # is in flight (matched by the done's operand reference)
+    starts = {e["name"]: i for i, e in enumerate(seq)
+              if e["kind"] == "red_start"}
+    async_pairs = 0
+    async_between = 0
+    for j, e in enumerate(seq):
+        if e["kind"] == "red_done" and e["start_ref"] in starts:
+            i = starts[e["start_ref"]]
+            async_pairs += 1
+            async_between += sum(1 for c in compute_pos if i < c < j)
+    return {
+        "entry_instructions": len(seq),
+        "reductions": len(red_pos),
+        "interleaved_gaps": interleaved,
+        "compute_between": between,
+        "async_pairs": async_pairs,
+        "async_compute_between": async_between,
+        "contiguous_tail_block": len(red_pos) >= 2 and between == 0,
+        "min_bytes": min_bytes,
+    }
+
+
 def preopt_hlo_text(lowered) -> str:
     """Pre-optimization HLO text from a ``jax.jit(...).lower(...)``
     result — where a requested bf16 wire dtype is still visible on

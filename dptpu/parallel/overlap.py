@@ -1,0 +1,352 @@
+"""Bucketed, backward-overlapped gradient communication.
+
+Every dptpu step used to emit its gradient reduction as one per-leaf
+sweep AFTER backward completed: ``lax.psum`` over the whole gradient
+tree (or the ZeRO-1 / hierarchical per-leaf equivalents), which lowers
+to one small collective per parameter leaf — 60+ latency-bound
+instructions for a ResNet, none of which the compiler is obliged to
+start before the last gradient exists.  The ImageNet-in-minutes systems
+pipeline instead (arXiv:1711.00705's pipelined all-reduce; the c10d
+bucketing engine the reference relies on, SURVEY.md §2b): gradients are
+packed into a few size-bounded BUCKETS and each bucket's reduction is
+issued the moment its gradients exist, so the network works while the
+remaining backward computes.
+
+The XLA-native translation (``DPTPU_OVERLAP=1``):
+
+* **partition** — the parameter tree flattens and packs into buckets of
+  at most ``DPTPU_BUCKET_MB`` (default 25 MB) in REVERSE flatten order:
+  backward produces the LAST layers' gradients first, so the first
+  bucket closed is the first one ready — the c10d ordering.  Tiny
+  leaves (BN scales, biases) coalesce into shared buckets; a leaf
+  larger than the bound gets its own bucket; buckets never mix dtypes
+  (the flat concatenation below requires one element type).
+* **in-backward issue** — each bucket's leaves pass through a
+  per-bucket ``jax.custom_vjp`` identity whose backward rule performs
+  the bucket's reduction on the cotangents: the reduction is therefore
+  PART OF the backward graph, anchored to exactly the sub-graph that
+  produces that bucket's gradients.  Buckets are independent (no
+  ordering edges between them), so the compiled schedule is free to
+  interleave each collective with the remaining backward computation —
+  which is precisely what the HLO overlap-evidence gate
+  (``dptpu check`` / ``hlo_accounting.overlap_evidence``) asserts.
+* **fused transport** — within a bucket the leaves are flattened and
+  concatenated into ONE contiguous buffer and reduced by ONE collective
+  (per hop), replacing per-leaf collectives: latency amortizes over the
+  bucket (the c10d win) while total bytes are EXACTLY the per-leaf
+  sum — the HLO budget gate locks total collective bytes ≡ the
+  unbucketed program's within 0.1%.
+
+**Composition** (the same three step families as the unbucketed path):
+
+* DDP, flat mesh — one ``psum`` of the flat bucket over the data axis.
+* DDP, hierarchical ``{slice, data}`` mesh — the PR-10 ladder runs per
+  bucket on the flat buffer: pad to a multiple of the intra-slice
+  width, reduce-scatter(ICI) → shard-sized DCN hop (fp32 psum or the
+  bf16 gather+local-sum compression) → all-gather(ICI) → unpad.
+* ZeRO-1 — the per-leaf weight all-gather's VJP ALREADY delivers each
+  gradient reduce-scattered during backward (the finest-grained
+  bucketing); the plan buckets the work that used to run post-backward:
+  the shard-sized cross-slice DCN hop and the replicated-remainder
+  psums, concatenated per bucket and issued in-backward right after the
+  VJP's reduce-scatter produces their inputs.
+* ``--accum-steps k > 1`` — gradients accumulate UNREDUCED across the
+  microbatch scan (the PR-6 contract: one reduction per update, never
+  per microbatch), so the bucketed reduction runs once, after the scan,
+  on the final accumulated gradients — same bucket collectives, without
+  the in-backward placement (a reduction inside the scan body would pay
+  k× the bytes).
+
+**Bit-identity contract** (locked in tests/test_overlap.py and the
+RACEBENCH/COMMBENCH parity gates): bucketing is a REGROUPING of the
+same per-element reductions — a collective sums corresponding elements
+across the same replicas whether the operand is one leaf or a
+concatenation of leaves, and the in-backward placement feeds it the
+same cotangent values the post-backward sweep would.  So
+``DPTPU_OVERLAP=1`` at any bucket count produces params Δ=0 against
+the unbucketed step, and multi-bucket ≡ single-bucket at Δ=0, for DDP,
+ZeRO-1 and the hierarchical mesh alike.  The intra-bucket reduction
+order is FIXED by the concatenation layout (reverse flatten order), so
+the contract cannot drift with partition changes.
+
+CPU-backend honesty (PARALLELISM.md): on this container overlap
+evidence is the compiled HLO schedule — per-bucket collectives
+interleaved with backward fusions — not a wall-clock win; virtual CPU
+devices share one memory bus, so the time saved by overlapping a
+"network" that is a memcpy cannot appear here.  RACEBENCH models the
+wall-clock win with measured per-bucket compute against analytic DCN
+bandwidth instead (scripts/run_racebench.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dptpu.parallel.mesh import DATA_AXIS, SLICE_AXIS
+
+DEFAULT_BUCKET_MB = 25.0
+
+
+def overlap_knobs() -> tuple:
+    """``(overlap, bucket_bytes, bucket_explicit)`` under the locked
+    fail-fast contract.
+
+    * ``DPTPU_OVERLAP`` — opt-in: bucket the gradient reduction and
+      issue each bucket in-backward (default off: the unbucketed
+      per-leaf reduction, today's exact code path).
+    * ``DPTPU_BUCKET_MB`` — bucket size bound in MB (float, > 0;
+      default 25 — the c10d ballpark).  Read and validated even when
+      overlap is off, so a typo'd value never waits silently for the
+      day the opt-in flips; ``bucket_explicit`` reports whether the
+      value was set (fit's advisory notice) so the knob keeps ONE
+      parse site.
+    """
+    from dptpu.envknob import env_bool, env_float
+
+    overlap = bool(env_bool("DPTPU_OVERLAP", False))
+    bucket_mb = env_float("DPTPU_BUCKET_MB", None)
+    explicit = bucket_mb is not None
+    if bucket_mb is None:
+        bucket_mb = DEFAULT_BUCKET_MB
+    if bucket_mb <= 0:
+        raise ValueError(
+            f"DPTPU_BUCKET_MB={bucket_mb} must be > 0 MB (the bucket "
+            f"size bound; fractional values are fine, e.g. "
+            f"DPTPU_BUCKET_MB=0.5)"
+        )
+    return overlap, int(bucket_mb * 1e6), explicit
+
+
+def _leaf_bytes(leaf) -> int:
+    size = int(np.prod(leaf.shape)) if getattr(leaf, "shape", ()) else 1
+    return size * jnp.dtype(leaf.dtype).itemsize
+
+
+def partition_buckets(tree, bucket_bytes: int) -> List[List[int]]:
+    """Partition a pytree's leaves into size-bounded buckets.
+
+    Returns a list of buckets, each a list of indices into
+    ``jax.tree_util.tree_leaves(tree)``.  Walk order is REVERSE flatten
+    order (flax flattens modules in definition order, so reversed ≈
+    reverse layer order — the gradients backward produces first land in
+    the earliest buckets); a bucket closes when adding the next leaf
+    would exceed ``bucket_bytes`` (a single over-sized leaf still gets
+    its own bucket) or when the dtype changes (the flat concatenation
+    requires one element type).  Consecutive tiny leaves coalesce into
+    one bucket; ``bucket_bytes >= total`` degenerates to ONE bucket
+    holding every leaf — the single-bucket ≡ unbucketed identity case.
+
+    Deterministic in the tree structure alone (shapes + dtypes), so the
+    partition — and with it the fixed intra-bucket reduction order — is
+    stable across processes, steps and resumes.
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes={bucket_bytes} must be > 0")
+    leaves = jax.tree_util.tree_leaves(tree)
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i in reversed(range(len(leaves))):
+        leaf = leaves[i]
+        nb = _leaf_bytes(leaf)
+        dt = jnp.dtype(leaf.dtype)
+        if cur and (dt != cur_dtype or cur_bytes + nb > bucket_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = dt
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_sizes_bytes(tree, buckets: Sequence[Sequence[int]]) -> List[int]:
+    """Per-bucket payload bytes (telemetry / the RACEBENCH model)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return [sum(_leaf_bytes(leaves[i]) for i in b) for b in buckets]
+
+
+def _concat_flat(arrs: Sequence[jax.Array]) -> jax.Array:
+    if len(arrs) == 1:
+        return arrs[0].reshape(-1)
+    return jnp.concatenate([a.reshape(-1) for a in arrs])
+
+
+def _split_flat(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
+    out, off = [], 0
+    for ref in like:
+        size = int(np.prod(ref.shape)) if ref.shape else 1
+        out.append(flat[off:off + size].reshape(ref.shape))
+        off += size
+    return out
+
+
+def hier_ladder_flat(flat: jax.Array, inner: int,
+                     dcn_dtype: str = "fp32") -> jax.Array:
+    """The PR-10 three-hop ladder on one flat bucket buffer:
+    reduce-scatter(ICI) → shard-sized DCN hop → all-gather(ICI).
+
+    The buffer pads to a multiple of the intra-slice width ``inner`` so
+    the scatter tiles evenly; the zero padding reduces to zero and is
+    sliced off after the gather (the pad is < ``inner`` elements per
+    bucket — noise against the 0.1% byte-parity gate).
+    """
+    from dptpu.parallel.hierarchy import dcn_reduce_shard
+
+    n = flat.shape[0]
+    pad = (-n) % inner
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, DATA_AXIS, scatter_dimension=0,
+                             tiled=True)
+    shard = dcn_reduce_shard(shard, SLICE_AXIS, dcn_dtype)
+    full = lax.all_gather(shard, DATA_AXIS, axis=0, tiled=True)
+    return full[:n] if pad else full
+
+
+def make_ddp_bucket_reduce(hier: bool, dcn_dtype: str = "fp32",
+                           inner: Optional[int] = None) -> Callable:
+    """The per-bucket reduction for the DDP step families.
+
+    Flat mesh: one ``psum`` of the concatenated bucket over the data
+    axis (the fused form of the per-leaf DDP all-reduce).  Hierarchical
+    mesh: the three-hop ladder on the flat buffer — including the
+    leaves the per-leaf ladder could not scatter (no divisible dim):
+    inside a flat buffer everything scatters, so the unscatterable
+    remainder stops crossing DCN at full width.
+    """
+    from dptpu.parallel.hierarchy import DCN_DTYPES
+
+    if dcn_dtype not in DCN_DTYPES:
+        raise ValueError(
+            f"dcn_dtype={dcn_dtype!r} must be one of "
+            + "/".join(repr(d) for d in DCN_DTYPES)
+        )
+    if hier and not inner:
+        raise ValueError("hierarchical bucket reduce needs the "
+                         "intra-slice width (inner)")
+
+    def reduce_bucket(cts: List[jax.Array], idxs: List[int]):
+        flat = _concat_flat(cts)
+        if hier:
+            red = hier_ladder_flat(flat, inner, dcn_dtype)
+        else:
+            red = lax.psum(flat, DATA_AXIS)
+        return _split_flat(red, cts)
+
+    return reduce_bucket
+
+
+def make_zero1_bucket_reduce(sharded_flags: Sequence[bool], hier: bool,
+                             dcn_dtype: str = "fp32") -> Callable:
+    """The per-bucket reduction for the ZeRO-1 step.
+
+    The cotangents arriving here are what the weight all-gather's VJP
+    produced: sharded leaves are ALREADY reduce-scattered over the
+    intra-slice axis, replicated leaves (no divisible dim) carry raw
+    local gradients.  Per bucket: the sharded shards concatenate and
+    take the shard-sized cross-slice DCN hop (hierarchical mesh only —
+    on a flat mesh they are complete and pass through untouched), and
+    the replicated remainder concatenates into one explicit psum
+    (sequential data-then-slice hops, matching the unbucketed step's
+    grouping exactly — the Δ=0 contract).
+    """
+
+    def reduce_bucket(cts: List[jax.Array], idxs: List[int]):
+        from dptpu.parallel.hierarchy import dcn_reduce_shard
+
+        out = list(cts)
+        shard_pos = [k for k, i in enumerate(idxs) if sharded_flags[i]]
+        repl_pos = [k for k, i in enumerate(idxs) if not sharded_flags[i]]
+        if hier and shard_pos:
+            flat = _concat_flat([cts[k] for k in shard_pos])
+            red = dcn_reduce_shard(flat, SLICE_AXIS, dcn_dtype)
+            for k, r in zip(shard_pos,
+                            _split_flat(red, [cts[k] for k in shard_pos])):
+                out[k] = r
+        if repl_pos:
+            flat = _concat_flat([cts[k] for k in repl_pos])
+            red = lax.psum(flat, DATA_AXIS)
+            if hier:
+                red = lax.psum(red, SLICE_AXIS)
+            for k, r in zip(repl_pos,
+                            _split_flat(red, [cts[k] for k in repl_pos])):
+                out[k] = r
+        return out
+
+    return reduce_bucket
+
+
+class OverlapPlan:
+    """One step's bucketed-reduction plan: a bucket-size bound plus the
+    per-bucket reduction, applied either IN-BACKWARD (``wrap`` — the
+    ``accum_steps == 1`` path) or post-accumulation (``reduce``).  Both
+    paths run the identical collectives on the identical values, so
+    they are bit-identical to each other and to the unbucketed step.
+    """
+
+    def __init__(self, bucket_bytes: int, reduce_bucket: Callable):
+        if bucket_bytes <= 0:
+            raise ValueError(
+                f"bucket_bytes={bucket_bytes} must be > 0 (DPTPU_BUCKET_MB)"
+            )
+        self.bucket_bytes = int(bucket_bytes)
+        self.reduce_bucket = reduce_bucket
+
+    def _buckets(self, tree) -> List[List[int]]:
+        return partition_buckets(tree, self.bucket_bytes)
+
+    def wrap(self, params):
+        """Thread each bucket's leaves through a custom-VJP identity
+        whose backward rule IS the bucket's reduction: autodiff anchors
+        the collective to exactly the sub-graph producing that bucket's
+        cotangents, so it is issued the moment those gradients exist —
+        with no ordering edges to the other buckets (independent
+        collectives, free to overlap the remaining backward)."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        new_leaves = list(leaves)
+        for bucket in self._buckets(params):
+            ident = _backward_reduce_identity(self.reduce_bucket,
+                                              tuple(bucket))
+            outs = ident(*[leaves[i] for i in bucket])
+            for i, o in zip(bucket, outs):
+                new_leaves[i] = o
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def reduce(self, grads):
+        """Post-hoc bucketed reduction (the gradient-accumulation path:
+        ONE reduction per update, after the microbatch scan)."""
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        new_leaves = list(leaves)
+        for bucket in self._buckets(grads):
+            outs = self.reduce_bucket([leaves[i] for i in bucket],
+                                      list(bucket))
+            for i, o in zip(bucket, outs):
+                new_leaves[i] = o
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _backward_reduce_identity(reduce_bucket: Callable, idxs: tuple):
+    """A fresh custom-VJP identity for one bucket: forward passes the
+    leaves through unchanged; backward applies the bucket reduction to
+    the cotangents."""
+
+    @jax.custom_vjp
+    def ident(*leaves):
+        return leaves
+
+    def fwd(*leaves):
+        return leaves, None
+
+    def bwd(_, cts):
+        return tuple(reduce_bucket(list(cts), list(idxs)))
+
+    ident.defvjp(fwd, bwd)
+    return ident

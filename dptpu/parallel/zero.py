@@ -217,7 +217,9 @@ def zero1_update_shard_bytes(state, mesh: Mesh) -> int:
 def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
                           lr_schedule=None, seed: int = 0,
                           accum_steps: int = 1, label_smoothing: float = 0.0,
-                          tx_factory=None, dcn_dtype: str = "fp32"):
+                          tx_factory=None, dcn_dtype: str = "fp32",
+                          overlap: bool = False,
+                          bucket_bytes=None):
     """ZeRO-1 / sharded-weight-update variant of
     ``dptpu.train.step.make_train_step``.
 
@@ -248,6 +250,17 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
     moves weights, never gradients), and ``reduce_grads`` adds only the
     shard-sized cross-slice hop over DCN — ONCE per update, after the
     accumulation scan, optionally bf16-compressed (``dcn_dtype``).
+
+    ``overlap=True`` (``DPTPU_OVERLAP=1``; dptpu/parallel/overlap.py):
+    the per-leaf all-gather VJP already delivers each gradient
+    reduce-scattered DURING backward — ZeRO-1's reduce-scatter is
+    maximally bucketed by construction — so the plan buckets the work
+    that used to run post-backward: per ``bucket_bytes`` bucket of
+    (shard-local) leaves, the shard-sized DCN hop and the
+    replicated-remainder psums concatenate into fused collectives
+    issued in-backward right behind the VJP's reduce-scatter.
+    Bit-identical to ``overlap=False`` (same collectives, same
+    grouping).
     """
     from dptpu.parallel.hierarchy import (
         DCN_DTYPES,
@@ -324,6 +337,26 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
 
         return jax.tree_util.tree_map(red, grads, specs.params)
 
+    overlap_plan = None
+    if overlap:
+        from dptpu.parallel.overlap import (
+            DEFAULT_BUCKET_MB,
+            OverlapPlan,
+            make_zero1_bucket_reduce,
+        )
+
+        sharded_flags = [
+            _sharded_axis(s) >= 0
+            for s in jax.tree_util.tree_leaves(
+                specs.params, is_leaf=lambda x: isinstance(x, P)
+            )
+        ]
+        overlap_plan = OverlapPlan(
+            bucket_bytes or int(DEFAULT_BUCKET_MB * 1e6),
+            make_zero1_bucket_reduce(sharded_flags, hier, dcn_dtype),
+        )
+        reduce_grads = None  # the plan carries the whole reduction
+
     def step(state, batch):
         return train_step_body(
             state, batch, compute_dtype=compute_dtype,
@@ -331,6 +364,7 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
             on_mesh=True, gather_params=gather_params,
             reduce_grads=reduce_grads, tx=tx, accum_steps=accum_steps,
             label_smoothing=label_smoothing, axis_names=axis_names,
+            overlap_plan=overlap_plan,
         )
 
     batch_spec = P(squeeze_axes(axis_names))

@@ -275,6 +275,62 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     from dptpu.parallel.hierarchy import hierarchy_knobs
 
     slices, dcn_dtype = hierarchy_knobs(cfg)
+    # overlapped gradient comms (DPTPU_OVERLAP / DPTPU_BUCKET_MB,
+    # dptpu/parallel/overlap.py) — validated here even when off
+    from dptpu.envknob import env_float as _env_float
+    from dptpu.envknob import env_str as _ramp_env_str
+    from dptpu.parallel.overlap import overlap_knobs
+
+    want_overlap, bucket_bytes, _bucket_explicit = overlap_knobs()
+    # extreme-scale recipe knobs (ISSUE 13): the batch-size ramp and
+    # the polynomial warmup exponent (arXiv:1811.05233), both under
+    # the locked fail-fast contract, both pre-compile
+    from dptpu.ops.schedules import (
+        parse_batch_ramp,
+        ramp_multiplier,
+        ramp_phase_start,
+    )
+
+    _ramp_spec = _ramp_env_str("DPTPU_BATCH_RAMP")
+    batch_ramp = parse_batch_ramp(_ramp_spec) if _ramp_spec else None
+    warmup_poly = _env_float("DPTPU_WARMUP_POLY", None)
+    if warmup_poly is not None and warmup_poly <= 0:
+        raise ValueError(
+            f"DPTPU_WARMUP_POLY={warmup_poly} must be > 0 (the warmup "
+            f"exponent; 1 is the linear ramp, 2 the 1811.05233 "
+            f"polynomial)"
+        )
+    if warmup_poly is not None and warmup_epochs == 0 \
+            and not cfg.evaluate:
+        # composition check only where a schedule is built: --evaluate
+        # trains nothing, so a training env's exported knob must not
+        # block a pure evaluation (the DPTPU_BATCH_RAMP treatment)
+        raise ValueError(
+            f"DPTPU_WARMUP_POLY={warmup_poly} needs a warmup phase to "
+            f"shape — set --warmup-epochs/DPTPU_WARMUP_EPOCHS > 0"
+        )
+    if batch_ramp is not None and not cfg.evaluate:
+        if warmup_epochs == 0:
+            raise ValueError(
+                "DPTPU_BATCH_RAMP is the large-batch recipe's ramp and "
+                "needs the warmup->cosine schedule — set "
+                "--warmup-epochs/DPTPU_WARMUP_EPOCHS > 0"
+            )
+        if cfg.epochs > 0 and batch_ramp[-1][0] >= cfg.epochs:
+            raise ValueError(
+                f"DPTPU_BATCH_RAMP names epoch {batch_ramp[-1][0]} but "
+                f"the run ends at --epochs {cfg.epochs} — that phase "
+                f"would never train"
+            )
+        if el_conf["straggler_factor"] is not None:
+            # the ramp swaps the loader (and its worker pool) at phase
+            # boundaries; the controller's per-worker estimators would
+            # silently describe a dead pool — fail fast naming both
+            raise ValueError(
+                "DPTPU_STRAGGLER_FACTOR does not compose with "
+                "DPTPU_BATCH_RAMP (phase switches rebuild the worker "
+                "pool under the controller); unset one of the two"
+            )
     initialize_distributed(cfg)
     derived = derive(
         cfg,
@@ -573,20 +629,44 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             shuffle=True,
             seed=cfg.seed if cfg.seed is not None else 0,
         )
-    train_loader = DataLoader(
-        train_ds,
-        host_batch,
-        sampler=train_sampler,
-        # the sum of the reference's per-GPU worker pools: each of the
-        # n_local device-slots gets ceil(workers / n_local) decode threads
-        # (imagenet_ddp.py:126), pooled in this host's single loader
-        num_workers=derived.workers_per_device * derived.local_device_count,
-        drop_last=True,
-        pad_final=False,
-        seed=cfg.seed if cfg.seed is not None else 0,
-        workers_mode=workers_mode,
-        leased=leased,
-    )
+    if batch_ramp is not None and cfg.evaluate:
+        if verbose:
+            print("=> DPTPU_BATCH_RAMP ignored: --evaluate does not train")
+        batch_ramp = None
+
+    def _ramp_mult(epoch: int) -> int:
+        return (ramp_multiplier(batch_ramp, epoch)
+                if batch_ramp is not None else 1)
+
+    def _spe(mult: int) -> int:
+        # mirrors DataLoader.__len__ under drop_last=True — the phase
+        # table must be computable WITHOUT building a loader per phase
+        return max(len(train_sampler) // (host_batch * mult), 1)
+
+    def _cum_steps(epoch: int) -> int:
+        # optimizer steps completed before `epoch` starts — the phase
+        # schedule's step anchor and the ramped --start-epoch offset
+        return sum(_spe(_ramp_mult(e)) for e in range(epoch))
+
+    def _make_train_loader(batch: int) -> DataLoader:
+        return DataLoader(
+            train_ds,
+            batch,
+            sampler=train_sampler,
+            # the sum of the reference's per-GPU worker pools: each of
+            # the n_local device-slots gets ceil(workers / n_local)
+            # decode threads (imagenet_ddp.py:126), pooled per host
+            num_workers=(derived.workers_per_device
+                         * derived.local_device_count),
+            drop_last=True,
+            pad_final=False,
+            seed=cfg.seed if cfg.seed is not None else 0,
+            workers_mode=workers_mode,
+            leased=leased,
+        )
+
+    ramp_mult = _ramp_mult(cfg.start_epoch)
+    train_loader = _make_train_loader(host_batch * ramp_mult)
     # Validation sharding follows the reference's split behavior:
     # * ddp/nd validate the FULL val set on every rank with no cross-rank
     #   reduction (imagenet_ddp.py:186-194, nd_imagenet.py) — here every
@@ -595,7 +675,27 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     #   averages are bit-identical on every host by construction;
     # * apex shards val and all-reduces the sums — exact aggregation
     #   (imagenet_ddp_apex.py:232-234,457-460).
-    full_val = cfg.variant in ("ddp", "nd")
+    # DPTPU_DIST_EVAL=1 (ISSUE 13 satellite): shard validation over the
+    # hosts for EVERY variant — the ddp/nd default feeds the FULL val
+    # set to every host (replicated work: N hosts decode N copies), the
+    # apex variant already shards. The in-step psum'd
+    # correct/count sums make the sharded aggregate EXACT, and on one
+    # host the shard IS the full set, so top1 is bit-identical to the
+    # single-stream pass by construction (locked in
+    # tests/test_overlap.py).
+    dist_eval = _os_environ_flag("DPTPU_DIST_EVAL")
+    full_val = cfg.variant in ("ddp", "nd") and not dist_eval
+    if dist_eval and verbose:
+        if cfg.variant in ("ddp", "nd") and derived.num_processes > 1:
+            print(
+                f"=> distributed eval: val set sharded over "
+                f"{derived.num_processes} hosts (exact psum-aggregated "
+                f"top1; each host decodes 1/{derived.num_processes} of "
+                f"the set instead of all of it)"
+            )
+        elif cfg.variant == "apex":
+            print("=> DPTPU_DIST_EVAL noted: the apex variant already "
+                  "shards validation (imagenet_ddp_apex.py:232-234)")
     val_loader = DataLoader(
         val_ds,
         host_batch,
@@ -672,6 +772,54 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     if use_gspmd and derived.sync_bn and verbose:
         print("=> --sync-bn is implicit under DPTPU_GSPMD: BatchNorm "
               "always sees the global batch in the single-program step")
+    # Bucketed backward-overlapped gradient comms (DPTPU_OVERLAP=1,
+    # dptpu/parallel/overlap.py): composes with the shard_map step
+    # families (DDP, ZeRO-1, --slices, --accum-steps); TP/SP/GSPMD
+    # derive or place their own collectives, and a mesh-less
+    # single-device step has none to overlap.
+    use_overlap = (
+        want_overlap and mesh is not None and not cfg.evaluate
+        and not use_tp and not use_sp and not use_gspmd
+    )
+    if want_overlap and not use_overlap and verbose:
+        why = (
+            "DPTPU_TP drives the GSPMD tensor-parallel step"
+            if use_tp
+            else "DPTPU_SP drives the sequence-parallel step"
+            if use_sp
+            else "DPTPU_GSPMD derives its own collectives (the "
+                 "partitioner schedules them; bucketing there is a "
+                 "follow-on)"
+            if use_gspmd
+            else "--evaluate does not train"
+            if cfg.evaluate and mesh is not None
+            else "single-device run (no gradient collective to overlap)"
+        )
+        print(f"=> DPTPU_OVERLAP ignored: {why}")
+    if _bucket_explicit and not want_overlap and verbose:
+        print(f"=> DPTPU_BUCKET_MB={bucket_bytes / 1e6:g} noted: the "
+              f"bucket bound only applies with DPTPU_OVERLAP=1")
+    if use_overlap and verbose:
+        print(
+            f"=> overlapped gradient comms: reverse-layer buckets of "
+            f"<= {bucket_bytes / 1e6:g} MB, each reduced as one fused "
+            f"collective issued inside backward (bit-identical to the "
+            f"unbucketed step)"
+        )
+    # ramp x parallel-topology composition: the ramp rebuilds the
+    # loader + step per phase, which only the shard_map families
+    # support — fail fast naming the knobs and both alternatives
+    if batch_ramp is not None and (use_tp or use_sp or use_gspmd):
+        who = ("DPTPU_TP" if use_tp else
+               "DPTPU_SP" if use_sp else "DPTPU_GSPMD")
+        raise ValueError(
+            f"DPTPU_BATCH_RAMP has no {who} composition (the ramp "
+            f"rebuilds the loader and step per phase; only the "
+            f"shard_map DDP/ZeRO-1/--slices families support that); "
+            f"supported alternatives: unset DPTPU_BATCH_RAMP and keep "
+            f"{who}, or unset {who} to run the ramped data-parallel "
+            f"recipe"
+        )
     # SyncBN spans EVERY replica: on a hierarchical mesh the BatchNorm
     # statistics pmean over both data axes (slice × dp_in_slice) — the
     # flax axis_name accepts the tuple like any jax collective
@@ -708,9 +856,28 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # apex linear-scaling rule's global_batch/256 factor already
     # carries the full batch scale.
     sched_lr = derived.scaled_lr
-    if warmup_epochs > 0:
+
+    def _phase_schedule(mult: int, epoch: int):
+        # ONE ramp phase's warmup->cosine in fractional epochs: the
+        # anchor (phase-start epoch, cumulative step count) is derived
+        # from the ramp table alone, so a resumed run reconstructs the
+        # identical schedule; the peak scales x mult per the
+        # linear-scaling rule (the batch grew x mult)
+        from dptpu.ops.schedules import make_ramp_phase_schedule
+
+        e0 = ramp_phase_start(batch_ramp, epoch)
+        return make_ramp_phase_schedule(
+            sched_lr * mult, _spe(mult), cfg.epochs, warmup_epochs,
+            epoch0=e0, step0=_cum_steps(e0),
+            power=warmup_poly if warmup_poly is not None else 1.0,
+        )
+
+    if batch_ramp is not None:
+        schedule = _phase_schedule(ramp_mult, cfg.start_epoch)
+    elif warmup_epochs > 0:
         schedule = make_warmup_cosine_schedule(
-            sched_lr, steps_per_epoch, cfg.epochs, warmup_epochs
+            sched_lr, steps_per_epoch, cfg.epochs, warmup_epochs,
+            power=warmup_poly if warmup_poly is not None else 1.0,
         )
     elif cfg.variant == "apex":
         schedule = make_warmup_step_decay_schedule(sched_lr, steps_per_epoch)
@@ -747,8 +914,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         tx,
         input_shape=(1, image_size, image_size, 3),
         # --start-epoch without --resume still lands on the reference's
-        # epoch-N learning rate (the schedule reads the global step)
-        initial_step=cfg.start_epoch * steps_per_epoch,
+        # epoch-N learning rate (the schedule reads the global step);
+        # under a batch ramp the offset is the cumulative step count
+        # over the earlier (differently-sized) phases
+        initial_step=(_cum_steps(cfg.start_epoch)
+                      if batch_ramp is not None
+                      else cfg.start_epoch * steps_per_epoch),
         variables=pretrained_vars,
     )
 
@@ -783,8 +954,32 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 # fail-fast names BOTH tuples. Pre-geometry files fall
                 # back to the data_position cross-check below.
                 saved_geom = tuple(meta.get("geometry", (-1, -1, -1)))
+                # under a batch ramp the geometry THIS run trains
+                # epoch N at is the ramped one — the stamp a mid-phase
+                # (or phase-boundary) save carries, so the cross-check
+                # compares ramped-to-ramped and a ramp boundary
+                # resumes exactly (ISSUE 13 satellite)
+                expect_geom = (
+                    (run_geom[0],
+                     run_geom[1] * _ramp_mult(meta["epoch"]),
+                     run_geom[2])
+                    if batch_ramp is not None else run_geom
+                )
                 if resume_step and saved_geom[0] >= 0 \
-                        and saved_geom != run_geom \
+                        and saved_geom != expect_geom \
+                        and batch_ramp is not None:
+                    raise ValueError(
+                        f"'{resolved}' was saved mid-epoch (step "
+                        f"{resume_step}) at geometry {saved_geom}, but "
+                        f"this run's DPTPU_BATCH_RAMP puts epoch "
+                        f"{meta['epoch']} at {expect_geom} — resume "
+                        f"with the ramp spec the save was made under "
+                        f"(DPTPU_ELASTIC does not compose with "
+                        f"DPTPU_BATCH_RAMP), or pass --start-epoch to "
+                        f"restart from an epoch boundary."
+                    )
+                if resume_step and saved_geom[0] >= 0 \
+                        and saved_geom != expect_geom \
                         and not el_conf["elastic"]:
                     raise ValueError(
                         f"'{resolved}' was saved mid-epoch (step "
@@ -801,7 +996,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                         f"per the linear-scaling rule)."
                     )
                 if resume_step and saved_geom[0] >= 0 \
-                        and saved_geom != run_geom:
+                        and saved_geom != expect_geom:
                     # the elastic shrink/grow remap (ROADMAP item 3a)
                     from dptpu.resilience.elastic import (
                         remap_resume_position,
@@ -862,21 +1057,25 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 # part of the epoch silently. (An elastic remap above
                 # already re-expressed the position in THIS geometry.)
                 meta_dp = int(meta.get("data_position", -1))
+                resume_host_batch = host_batch * _ramp_mult(meta["epoch"])
                 if elastic_resume is None and resume_step \
                         and meta_dp >= 0 \
-                        and meta_dp != resume_step * host_batch:
+                        and meta_dp != resume_step * resume_host_batch:
                     raise ValueError(
                         f"'{resolved}' was saved at step {resume_step} "
                         f"with {meta_dp} samples consumed per host, but "
-                        f"this run's per-host batch is {host_batch} "
-                        f"({resume_step} x {host_batch} = "
-                        f"{resume_step * host_batch}) — the batch "
+                        f"this run's per-host batch is "
+                        f"{resume_host_batch} "
+                        f"({resume_step} x {resume_host_batch} = "
+                        f"{resume_step * resume_host_batch}) — the batch "
                         f"geometry changed, so the exact mid-epoch "
                         f"replay is impossible. Resume with the "
                         f"original batch size, or pass --start-epoch "
                         f"to restart from an epoch boundary."
                     )
-                if resume_step >= steps_per_epoch:
+                if resume_step >= (_spe(_ramp_mult(start_epoch))
+                                   if batch_ramp is not None
+                                   else steps_per_epoch):
                     # a mid-epoch save from a run with MORE steps/epoch
                     # (different batch size/dataset): the exact replay
                     # contract is void, so land on the next boundary
@@ -893,6 +1092,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             # warn-and-continue, reference behavior (imagenet_ddp.py:152-153)
             if verbose:
                 print(f"=> no checkpoint found at '{cfg.resume}'")
+
+    if batch_ramp is not None and _ramp_mult(start_epoch) != ramp_mult:
+        # the resume landed in a different ramp phase than the loaders
+        # were provisionally built for: re-enter the resumed phase
+        # BEFORE any step compiles (the loop-top switcher handles
+        # later boundaries; this handles the entry point)
+        ramp_mult = _ramp_mult(start_epoch)
+        train_loader.close()
+        train_loader = _make_train_loader(host_batch * ramp_mult)
+        steps_per_epoch = max(len(train_loader), 1)
+        schedule = _phase_schedule(ramp_mult, start_epoch)
 
     # want_zero1/use_zero1 were computed once, before model build (the
     # GSPMD-precedence block) — reused here so the rule cannot desync.
@@ -914,15 +1124,22 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # tests/test_zero1.py). Checkpoints and eval read the state
         # transparently (sharded leaves are global jax.Arrays);
         # eval/checkpoint gathers are per-epoch, not per-step.
-        train_step = make_zero1_train_step(
-            mesh, state, compute_dtype, lr_schedule=schedule,
-            seed=cfg.seed if cfg.seed is not None else 0,
-            accum_steps=accum_steps, label_smoothing=label_smooth,
-            tx_factory=partial(
-                make_optimizer, cfg.momentum, cfg.weight_decay, opt_name
-            ),
-            dcn_dtype=dcn_dtype if use_hier else "fp32",
-        )
+        def _build_train_step(sched):
+            # `state` binds late: a ramp-phase rebuild mid-run passes
+            # the LIVE sharded state as the template (same structure)
+            return make_zero1_train_step(
+                mesh, state, compute_dtype, lr_schedule=sched,
+                seed=cfg.seed if cfg.seed is not None else 0,
+                accum_steps=accum_steps, label_smoothing=label_smooth,
+                tx_factory=partial(
+                    make_optimizer, cfg.momentum, cfg.weight_decay,
+                    opt_name
+                ),
+                dcn_dtype=dcn_dtype if use_hier else "fp32",
+                overlap=use_overlap, bucket_bytes=bucket_bytes,
+            )
+
+        train_step = _build_train_step(schedule)
         from dptpu.parallel import zero1_update_shard_bytes
 
         opt_shard_bytes = zero1_update_shard_bytes(state, mesh)
@@ -1005,12 +1222,16 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 f"(tokens pad to multiples of {sp_n}; cls psum-recovered)"
             )
     else:
-        train_step = make_train_step(
-            mesh, compute_dtype, lr_schedule=schedule,
-            seed=cfg.seed if cfg.seed is not None else 0,
-            accum_steps=accum_steps, label_smoothing=label_smooth,
-            dcn_dtype=dcn_dtype if use_hier else "fp32",
-        )
+        def _build_train_step(sched):
+            return make_train_step(
+                mesh, compute_dtype, lr_schedule=sched,
+                seed=cfg.seed if cfg.seed is not None else 0,
+                accum_steps=accum_steps, label_smoothing=label_smooth,
+                dcn_dtype=dcn_dtype if use_hier else "fp32",
+                overlap=use_overlap, bucket_bytes=bucket_bytes,
+            )
+
+        train_step = _build_train_step(schedule)
         eval_view = lambda s: s  # noqa: E731
         eval_view_gathers = False
     eval_step = make_eval_step(mesh, compute_dtype)
@@ -1121,10 +1342,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         keep=cfg.ckpt_keep,
         is_chief=derived.is_chief,
         arch=cfg.arch,
-        batch_size=host_batch,
+        # data_position stamps samples-consumed-per-host: under a ramp
+        # that is the PHASE batch (kept current by the phase switcher)
+        batch_size=host_batch * ramp_mult,
         fault_plan=fault_plan,
         async_writer=ckpt_writer,
-        geometry=run_geom,
+        # under a batch ramp every save stamps the PHASE geometry (the
+        # global batch actually trained at that epoch), so a resume
+        # cross-checks ramped-to-ramped and a ramp boundary resumes
+        # exactly; the loop-top phase switcher keeps this current
+        geometry=(run_geom[0], run_geom[1] * ramp_mult, run_geom[2])
+        if batch_ramp is not None else run_geom,
     )
     guard = PreemptionGuard()
     # quorum coordination (dptpu/resilience/quorum.py): when a
@@ -1296,6 +1524,47 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
 
     result = {"history": [], "early_stopped": False, "training_time": None,
               "preempted": False}
+    ramp_record = []
+    if batch_ramp is not None:
+        ramp_record.append({
+            "epoch": start_epoch, "mult": ramp_mult,
+            "global_batch": run_geom[1] * ramp_mult,
+            "steps_per_epoch": steps_per_epoch,
+            "peak_lr": sched_lr * ramp_mult,
+        })
+
+    def _enter_ramp_phase(m: int, epoch: int):
+        # the batch-size ramp's phase switch (arXiv:1811.05233): bigger
+        # per-host batch, fewer steps/epoch, peak LR x m per the
+        # linear-scaling rule, geometry stamp updated so checkpoints
+        # carry the phase they were trained at. LOUD by contract — a
+        # changed batch/LR must never scroll by silently.
+        nonlocal train_loader, train_step, schedule, steps_per_epoch
+        nonlocal ramp_mult
+        old_batch = host_batch * ramp_mult
+        ramp_mult = m
+        train_loader.close()
+        train_loader = _make_train_loader(host_batch * m)
+        steps_per_epoch = max(len(train_loader), 1)
+        schedule = _phase_schedule(m, epoch)
+        train_step = _build_train_step(schedule)
+        manager.geometry = (run_geom[0], run_geom[1] * m, run_geom[2])
+        manager.batch_size = host_batch * m
+        if fault_plan is not None:
+            fault_plan.bind_worker_kill(train_loader.kill_one_worker)
+        ramp_record.append({
+            "epoch": epoch, "mult": m,
+            "global_batch": run_geom[1] * m,
+            "steps_per_epoch": steps_per_epoch,
+            "peak_lr": sched_lr * m,
+        })
+        print(
+            f"=> BATCH RAMP at epoch {epoch}: per-host batch "
+            f"{old_batch} -> {host_batch * m} (global "
+            f"{run_geom[1] * m}), {steps_per_epoch} steps/epoch, peak "
+            f"LR -> {sched_lr * m:g} per the linear-scaling rule",
+            file=sys.stderr,
+        )
     # last position at which `state` is known consistent — the boundary
     # fallback for the best-effort save below (mid-epoch exceptions save
     # their exact position through train_one_epoch's emergency_cb)
@@ -1304,6 +1573,9 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     try:
       with guard:
         for epoch in range(start_epoch, cfg.epochs):
+            if batch_ramp is not None \
+                    and _ramp_mult(epoch) != ramp_mult:
+                _enter_ramp_phase(_ramp_mult(epoch), epoch)
             start_step = resume_step if epoch == start_epoch else 0
             current_pos = {"epoch": epoch, "step": start_step}
             if qs is not None:
@@ -1471,8 +1743,12 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             # carry them. Tags are unchanged: dashboards keep working.
             bt = max(train_stats["batch_time"], 1e-9)
             val_bt = max(val_stats.get("batch_time", bt), 1e-9)
+            # under a batch ramp a step consumes the PHASE batch —
+            # ramp_mult follows the switcher, so throughput stays
+            # honest across phases (val keeps the base batch)
             scalars = {
-                "Throughput/train": derived.global_batch_size / bt,
+                "Throughput/train":
+                    derived.global_batch_size * ramp_mult / bt,
                 "Throughput/val": derived.global_batch_size / val_bt,
                 "Time/train": train_stats["batch_time"],
                 "Time/val": val_bt,
@@ -1688,6 +1964,8 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # (and an operator's post-mortem's) machine-readable record
     if elastic_resume is not None:
         result["elastic"] = elastic_resume
+    if batch_ramp is not None:
+        result["batch_ramp"] = ramp_record
     if lost["flag"]:
         result["host_lost"] = True
     if qs is not None:

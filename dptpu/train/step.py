@@ -115,7 +115,8 @@ def normalize_images(images, dtype=jnp.float32):
 def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
                     axis_size, on_mesh, gather_params=None,
                     reduce_grads=None, tx=None, accum_steps=1,
-                    label_smoothing=0.0, axis_names=(DATA_AXIS,)):
+                    label_smoothing=0.0, axis_names=(DATA_AXIS,),
+                    overlap_plan=None):
     """The shared per-shard train-step math — ONE source of truth for the
     DDP step below, the ZeRO-1 step (dptpu/parallel/zero.py) and the
     GSPMD step (dptpu/parallel/gspmd.py), which differ only in their
@@ -152,8 +153,21 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
     dropout replica id flattens over them slice-major (so it equals the
     flat mesh's index for the same chip) and the BN-stat/metric pmeans
     span all replicas either way.
+
+    ``overlap_plan`` (``DPTPU_OVERLAP=1``; dptpu/parallel/overlap.py)
+    REPLACES ``reduce_grads`` with the bucketed engine: at
+    ``accum_steps == 1`` each bucket's reduction is part of the
+    backward graph (issued the moment its gradients exist); under
+    accumulation the bucketed reduction runs once, after the scan —
+    the one-reduction-per-update contract unchanged.  Bit-identical to
+    the unbucketed path at any bucket count (the regrouping argument —
+    see the overlap module docstring).
     """
     labels = batch["labels"]
+    wrap_params = (
+        overlap_plan.wrap
+        if overlap_plan is not None and accum_steps == 1 else None
+    )
     step_key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
     tx = state.tx if tx is None else tx
     pmean_axes = squeeze_axes(axis_names)
@@ -162,6 +176,11 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
         images = normalize_images(images_u8, compute_dtype)
 
         def loss_fn(params):
+            if wrap_params is not None:
+                # the overlap engine's per-bucket custom-VJP boundary:
+                # backward through this identity performs the bucket's
+                # reduction in-place in the backward graph
+                params = wrap_params(params)
             full = gather_params(params) if gather_params else params
             out, mutated = state.apply_fn(
                 {"params": full, "batch_stats": state.batch_stats},
@@ -252,7 +271,11 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
             s_acc, state.batch_stats,
         )
         loss, top1, top5 = m_acc[0] / k, m_acc[1] / k, m_acc[2] / k
-    if reduce_grads is not None:
+    if overlap_plan is not None and wrap_params is None:
+        # accumulation x overlap: the bucketed reduction runs ONCE per
+        # update, on the post-scan accumulated gradients
+        grads = overlap_plan.reduce(grads)
+    elif reduce_grads is not None:
         # the ONE explicit cross-replica gradient reduction (DDP
         # all-reduce / ZeRO-1 replicated-leaf psum)
         grads = reduce_grads(grads)
@@ -295,7 +318,8 @@ def train_step_body(state, batch, *, compute_dtype, lr_schedule, seed,
 
 def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
                     lr_schedule=None, seed: int = 0, accum_steps: int = 1,
-                    label_smoothing: float = 0.0, dcn_dtype: str = "fp32"):
+                    label_smoothing: float = 0.0, dcn_dtype: str = "fp32",
+                    overlap: bool = False, bucket_bytes: Optional[int] = None):
     """Build the jitted train step.
 
     Returns ``step(state, batch) -> (state, metrics)`` where ``batch`` is a
@@ -329,6 +353,16 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
     compressing the DCN hop (fp32 accumulation). Under accumulation the
     whole three-hop reduction still runs ONCE per update, after the
     microbatch scan — never per microbatch.
+
+    ``overlap=True`` (``DPTPU_OVERLAP=1``) swaps the per-leaf reduction
+    for the bucketed backward-overlapped engine
+    (dptpu/parallel/overlap.py): the gradient tree packs into
+    ``bucket_bytes``-bounded buckets in reverse layer order and each
+    bucket reduces as ONE fused collective, issued inside the backward
+    graph the moment its gradients exist (the hierarchical ladder runs
+    per bucket on the flat buffer).  Bit-identical to ``overlap=False``
+    at any bucket count.  No-op on a mesh-less single-device step
+    (there is no collective to overlap).
     """
 
     if lr_schedule is None:
@@ -343,7 +377,20 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
     axis_size = data_parallel_width(mesh)
     hier = is_hierarchical(mesh)
     reduce_grads = None
-    if hier:
+    overlap_plan = None
+    if overlap and mesh is not None:
+        from dptpu.parallel.overlap import (
+            DEFAULT_BUCKET_MB,
+            OverlapPlan,
+            make_ddp_bucket_reduce,
+        )
+
+        inner = int(mesh.shape[DATA_AXIS]) if hier else None
+        overlap_plan = OverlapPlan(
+            bucket_bytes or int(DEFAULT_BUCKET_MB * 1e6),
+            make_ddp_bucket_reduce(hier, dcn_dtype, inner=inner),
+        )
+    elif hier:
         # the two-level reduction: per-chip DCN bytes ~1/dp_in_slice of
         # the flat all-reduce (the Mikami/Yamazaki hierarchy)
         reduce_grads = make_hierarchical_reduce(mesh, dcn_dtype)
@@ -359,7 +406,7 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
             lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
             on_mesh=mesh is not None, reduce_grads=reduce_grads,
             accum_steps=accum_steps, label_smoothing=label_smoothing,
-            axis_names=axis_names,
+            axis_names=axis_names, overlap_plan=overlap_plan,
         )
 
     opts = tpu_compiler_options()

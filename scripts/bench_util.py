@@ -39,3 +39,40 @@ def make_jpeg_imagefolder(root: str, n_images: int, n_classes: int = 2,
             noise = rng.randint(0, 255, (low[1], low[0], 3), np.uint8)
             img = Image.fromarray(noise).resize(px, Image.BILINEAR)
             img.save(os.path.join(d, f"{i}.jpg"), quality=quality)
+
+
+def ensure_cpu_pool(n: int, child_env: str):
+    """Re-exec into a child with an n-device virtual CPU pool unless
+    this process already sees n devices — the shared bootstrap for the
+    multi-chip benches (scalebench/commbench/racebench; sitecustomize
+    imports jax at interpreter startup, so JAX_PLATFORMS/XLA_FLAGS need
+    a re-exec to beat the backend latch). ``child_env`` is the bench's
+    registered re-entry sentinel (dptpu/analysis/knobs.py); the child
+    VERIFIES the pool instead of trusting the env vars."""
+    import subprocess
+    import sys
+
+    import __graft_entry__ as ge
+
+    import jax
+
+    from dptpu.envknob import env_str
+
+    if env_str(child_env):
+        if jax.device_count() < n:
+            raise RuntimeError(
+                f"re-exec'd child still sees {jax.device_count()} "
+                f"device(s), need {n} — the jax backend latched before "
+                "JAX_PLATFORMS/XLA_FLAGS took effect on this image"
+            )
+        return
+    if jax.device_count() >= n:
+        return
+    env = dict(os.environ)
+    env[child_env] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ge._with_device_count_flag(
+        env.get("XLA_FLAGS", ""), n
+    )
+    rc = subprocess.run([sys.executable] + sys.argv, env=env).returncode
+    sys.exit(rc)

@@ -36,6 +36,15 @@ made measurable, from the compiled programs' own accounting:
    relative shape is meaningful; DCN is not slower than ICI here, so
    the hierarchy's win CANNOT show on this host — re-run on a real
    multi-slice pod for the headline.
+5. **Overlap arm** (ISSUE 13) — the bucketed backward-overlapped
+   engine (``DPTPU_OVERLAP=1``, dptpu/parallel/overlap.py) on the
+   composed mesh: params Δ=0 against the unbucketed hierarchical step
+   over the full trajectory (the regrouping contract), per-link DCN
+   bytes within 2% of the unbucketed ladder's (flat-buffer padding is
+   < chips_per_slice elements per bucket), and the compiled schedule
+   shows >= 2 per-bucket reductions interleaved with backward compute
+   (``hlo_accounting.overlap_evidence`` — the same evidence ``dptpu
+   check`` gates; the wall-clock model lives in RACEBENCH.json).
 
 Usage: python scripts/run_commbench.py [--slices 2] [--chips-per-slice 2]
        [--arch resnet18] [--steps 5] [--smoke] [--out COMMBENCH.json]
@@ -49,9 +58,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dptpu.envknob import env_str  # noqa: E402
-
 import numpy as np
+
+from bench_util import ensure_cpu_pool  # noqa: E402
 
 _CHILD_ENV = "DPTPU_COMMBENCH_CHILD"
 
@@ -71,35 +80,9 @@ BF16_HALVING_MAX = 0.55     # bf16 DCN <= 0.55x fp32 DCN (ideal 0.50)
 FP32_COMPOSED_STEP1_REL = 1e-6
 BF16_COMPOSED_STEP1_REL = 5e-3
 COMPOSED_REGIME_REL = 0.5
-
-
-def _ensure_cpu_pool(n: int):
-    """Re-exec into a child with an n-device virtual CPU pool unless
-    this process already sees n devices (the run_scalebench pattern —
-    sitecustomize imports jax at startup, so env vars need a re-exec
-    to beat the backend latch)."""
-    import __graft_entry__ as ge
-
-    import jax
-
-    if env_str(_CHILD_ENV):
-        if jax.device_count() < n:
-            raise RuntimeError(
-                f"re-exec'd child still sees {jax.device_count()} "
-                f"device(s), need {n} — the jax backend latched before "
-                "JAX_PLATFORMS/XLA_FLAGS took effect on this image"
-            )
-        return
-    if jax.device_count() >= n:
-        return
-    env = dict(os.environ)
-    env[_CHILD_ENV] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = ge._with_device_count_flag(env.get("XLA_FLAGS", ""), n)
-    import subprocess
-
-    rc = subprocess.run([sys.executable] + sys.argv, env=env).returncode
-    sys.exit(rc)
+# overlap arm: flat-bucket padding adds < chips_per_slice elements per
+# bucket, so per-link bytes sit within 2% of the unbucketed ladder
+OVERLAP_DCN_RTOL = 0.02
 
 
 def main():
@@ -111,6 +94,8 @@ def main():
     ap.add_argument("--per-chip-batch", type=int, default=8)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--time-reps", type=int, default=8)
+    ap.add_argument("--bucket-mb", type=float, default=8.0,
+                    help="overlap arm's bucket bound (DPTPU_BUCKET_MB)")
     ap.add_argument("--smoke", action="store_true",
                     help="gates only: skip the ZeRO-1 arms and the "
                          "step-time sweep (the tier-1 preset)")
@@ -121,7 +106,7 @@ def main():
         raise SystemExit("need >= 2 slices x >= 2 chips/slice (the "
                          "acceptance geometry)")
     N = S * I
-    _ensure_cpu_pool(N)
+    ensure_cpu_pool(N, _CHILD_ENV)
 
     import jax
 
@@ -203,13 +188,17 @@ def main():
         )
 
     print(f"=> compiling {args.arch}@{args.image} on {S}x{I} "
-          f"(flat + 4 hierarchical arms)", file=sys.stderr)
+          f"(flat + 5 hierarchical arms)", file=sys.stderr)
     flat_c, flat_opt, _ = compile_arm(flat_mesh)
     arms = {}
     for name, mesh in meshes.items():
         arms[name] = compile_arm(mesh)
     bf16_c, bf16_opt, bf16_pre = compile_arm(
         meshes["composed"], dcn_dtype="bf16"
+    )
+    overlap_c, overlap_opt, _ = compile_arm(
+        meshes["composed"], overlap=True,
+        bucket_bytes=int(args.bucket_mb * 1e6),
     )
 
     # ---- 1+2: HLO byte accounting -------------------------------------
@@ -262,6 +251,26 @@ def main():
     parity["bf16_composed_max_delta"] = max_abs_diff(
         run_arm(bf16_c, meshes["composed"], args.steps), params_flat
     )
+    # ---- 5: overlap arm ------------------------------------------------
+    from dptpu.parallel.hlo_accounting import overlap_evidence
+
+    overlap_link = collective_bytes_by_link(overlap_opt, slice_of, N)
+    overlap_ev = overlap_evidence(overlap_opt)
+    parity["overlap_vs_hier_max_delta"] = max_abs_diff(
+        run_arm(overlap_c, meshes["composed"], args.steps),
+        params_composed,  # the parity section's composed-arm run
+    )
+    overlap_dcn_ratio = (
+        overlap_link["dcn"]["total"]
+        / max(hier_link["dcn"]["total"], 1)
+    )
+    overlap_ok = (
+        parity["overlap_vs_hier_max_delta"] == 0.0
+        and abs(overlap_dcn_ratio - 1.0) <= OVERLAP_DCN_RTOL
+        and overlap_ev["reductions"] >= 2
+        and overlap_ev["interleaved_gaps"] >= 1
+    )
+
     parity_ok = (
         parity["fp32_pure_ici_max_delta"] == 0.0
         and parity["fp32_pure_dcn_max_delta"] == 0.0
@@ -314,7 +323,19 @@ def main():
             "chaotically, so the multi-step composed delta is recorded "
             "with a loose same-regime bound, never hidden."
         ),
+        "overlap_bucket_mb": args.bucket_mb,
+        "overlap_by_link": overlap_link,
+        "overlap_dcn_vs_hier_ratio": overlap_dcn_ratio,
+        "overlap_evidence": overlap_ev,
         "gates": {
+            "overlap_ok": bool(overlap_ok),
+            "overlap_gate": (
+                f"DPTPU_OVERLAP params Δ=0 vs the unbucketed "
+                f"hierarchical step over {args.steps} steps, DCN bytes "
+                f"within {OVERLAP_DCN_RTOL:.0%} of the ladder's, >= 2 "
+                f"per-bucket reductions interleaved with backward in "
+                f"the schedule"
+            ),
             "dcn_bytes_ok": bool(dcn_ok),
             "dcn_gate": f"hier DCN <= {DCN_IDEAL_FACTOR} x flat/{I}",
             "bf16_halving_ok": bool(bf16_ok),

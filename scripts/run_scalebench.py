@@ -45,45 +45,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dptpu.envknob import env_str  # noqa: E402
-
 import numpy as np
 
+from bench_util import ensure_cpu_pool  # noqa: E402
+
 _CHILD_ENV = "DPTPU_SCALEBENCH_CHILD"
-
-
-def _ensure_cpu_pool(n: int):
-    """Re-exec into a child with an n-device virtual CPU pool unless this
-    process can already see n devices (same latching problem as
-    __graft_entry__: sitecustomize imports jax at startup)."""
-    import __graft_entry__ as ge
-
-    import jax
-
-    if env_str(_CHILD_ENV):
-        # the env vars below only work if they beat the backend latch;
-        # verify instead of trusting (same failure _force_cpu_devices
-        # diagnoses for the dryrun child)
-        if jax.device_count() < n:
-            raise RuntimeError(
-                f"re-exec'd child still sees {jax.device_count()} "
-                f"device(s), need {n} — the jax backend latched before "
-                "JAX_PLATFORMS/XLA_FLAGS took effect on this image"
-            )
-        return
-
-    if jax.device_count() >= n:
-        return
-    env = dict(os.environ)
-    env[_CHILD_ENV] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = ge._with_device_count_flag(
-        env.get("XLA_FLAGS", ""), n
-    )
-    import subprocess
-
-    rc = subprocess.run([sys.executable] + sys.argv, env=env).returncode
-    sys.exit(rc)
 
 
 def _collective_bytes_per_chip(hlo_text: str, n: int) -> dict:
@@ -118,7 +84,7 @@ def main():
     args = ap.parse_args()
     widths = [int(w) for w in args.widths.split(",")]
 
-    _ensure_cpu_pool(max(widths))
+    ensure_cpu_pool(max(widths), _CHILD_ENV)
 
     import jax
     import jax.numpy as jnp

@@ -79,6 +79,13 @@ _FAST_MODULES = {
     # (the test_hierarchy precedent, cached module-wide) — the
     # zero-findings + HLO-budget acceptance bars MUST hold in tier 1
     "test_analysis", "test_analysis_repo",
+    # overlapped gradient comms (ISSUE 13): partitioner/evidence units
+    # are pure; the parity ladder compiles TinyDense-sized shard_map
+    # steps (the test_hierarchy precedent) and holds the acceptance
+    # bars — overlap Δ=0 for DDP/ZeRO-1/slices MUST hold in tier 1;
+    # the racebench smoke is the seventh fit-shaped exception (one
+    # subprocess, --smoke preset, same gates as RACEBENCH.json)
+    "test_overlap", "test_racebench_smoke",
 }
 
 

@@ -53,3 +53,10 @@ def test_commbench_smoke_gates(tmp_path):
     hier = bench["hier_fp32_by_link"]
     assert hier["dcn"]["reduce-scatter"] == 0
     assert hier["dcn"]["total"] < bench["flat_allreduce_per_chip"]["total"]
+    # the overlap arm (ISSUE 13): Δ=0 vs the unbucketed ladder, DCN
+    # bytes within the padding tolerance, schedule evidence present
+    assert gates["overlap_ok"]
+    assert bench["parity"]["overlap_vs_hier_max_delta"] == 0.0
+    assert abs(bench["overlap_dcn_vs_hier_ratio"] - 1.0) <= 0.02
+    assert bench["overlap_evidence"]["reductions"] >= 2
+    assert bench["overlap_evidence"]["interleaved_gaps"] >= 1

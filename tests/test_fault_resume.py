@@ -292,3 +292,87 @@ def test_emergency_checkpoint_on_unexpected_crash(tmp_path, monkeypatch):
     assert saved, "emergency save did not run"
     resolved = find_resumable(".", verbose=False)
     assert resolved is not None
+
+
+def test_overlap_midepoch_resume_is_bit_identical(tmp_path_factory,
+                                                  monkeypatch):
+    """ISSUE 13: DPTPU_OVERLAP=1 (bucketed in-backward reductions on
+    the 8-device mesh) + mid-epoch SIGTERM + --resume reproduces the
+    uninterrupted overlap-on run bit for bit — the overlap engine
+    changes WHERE the collectives run, never what the replay contract
+    sees."""
+    monkeypatch.setenv("DPTPU_OVERLAP", "1")
+    monkeypatch.setenv("DPTPU_BUCKET_MB", "1")
+    cfg_kw = dict(gpu=None, batch_size=24, epochs=2)  # the full fake pod
+    da = tmp_path_factory.mktemp("overlap_base")
+    cwd = os.getcwd()
+    os.chdir(da)
+    try:
+        ra = fit(_cfg(**cfg_kw), image_size=32, verbose=False)
+    finally:
+        os.chdir(cwd)
+    assert ra["epochs_run"] == 2
+
+    db = tmp_path_factory.mktemp("overlap_chaos")
+    monkeypatch.chdir(db)
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=2")
+    r1 = fit(_cfg(**cfg_kw), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    monkeypatch.delenv("DPTPU_FAULT")
+    r2 = fit(_cfg(resume=str(db), **cfg_kw), image_size=32,
+             verbose=False)
+    assert r2["epochs_run"] == 2
+    assert _params_max_delta(ra["state"], r2["state"]) == 0.0
+
+
+def test_batch_ramp_resume_in_ramped_phase_is_bit_identical(
+        tmp_path_factory, monkeypatch):
+    """ISSUE 13 satellite: the batch ramp stamps the PHASE geometry
+    into every checkpoint, so a SIGTERM inside the RAMPED phase (the
+    batch just doubled, the loader/step were rebuilt, the LR rescaled)
+    resumes bit-identically — and the resumed run reconstructs the
+    phase schedule from the ramp table alone."""
+    monkeypatch.setenv("DPTPU_BATCH_RAMP", "2:2")
+    cfg_kw = dict(gpu=None, batch_size=24, epochs=3, warmup_epochs=1)
+    da = tmp_path_factory.mktemp("ramp_base")
+    cwd = os.getcwd()
+    os.chdir(da)
+    try:
+        ra = fit(_cfg(**cfg_kw), image_size=32, verbose=False)
+    finally:
+        os.chdir(cwd)
+    assert ra["epochs_run"] == 3
+    assert [p["mult"] for p in ra["batch_ramp"]] == [1, 2]
+
+    db = tmp_path_factory.mktemp("ramp_chaos")
+    monkeypatch.chdir(db)
+    # phase 0: 96/24 = 4 steps x 2 epochs; phase 1 (epoch 2): batch 48,
+    # 2 steps. Step 9 = one step INTO the ramped phase.
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=9")
+    r1 = fit(_cfg(**cfg_kw), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    monkeypatch.delenv("DPTPU_FAULT")
+    r2 = fit(_cfg(resume=str(db), **cfg_kw), image_size=32,
+             verbose=False)
+    assert r2["epochs_run"] >= 1
+    assert _params_max_delta(ra["state"], r2["state"]) == 0.0
+
+
+def test_batch_ramp_resume_wrong_ramp_fails_actionably(
+        tmp_path_factory, monkeypatch):
+    """A checkpoint saved inside a ramped phase must refuse a resume
+    whose ramp spec puts that epoch at a DIFFERENT geometry — naming
+    the spec, not silently replaying the wrong batch."""
+    monkeypatch.setenv("DPTPU_BATCH_RAMP", "1:2")
+    cfg_kw = dict(gpu=None, batch_size=24, epochs=3, warmup_epochs=1)
+    d = tmp_path_factory.mktemp("ramp_wrong")
+    monkeypatch.chdir(d)
+    # stop INSIDE the ramped phase (epoch 1, batch 48: 4 + 1 steps)
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=5")
+    r1 = fit(_cfg(**cfg_kw), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    monkeypatch.delenv("DPTPU_FAULT")
+    # resume under a DIFFERENT ramp (epoch 1 now x4): geometry mismatch
+    monkeypatch.setenv("DPTPU_BATCH_RAMP", "1:4")
+    with pytest.raises(ValueError, match="DPTPU_BATCH_RAMP"):
+        fit(_cfg(resume=str(d), **cfg_kw), image_size=32, verbose=False)

@@ -165,3 +165,151 @@ def test_cli_flags_parse_into_config():
     # the parser rejects an unknown optimizer at the CLI boundary too
     with pytest.raises(SystemExit):
         parse_config(["--optimizer", "adam", "data"], variant="ddp")
+
+
+# --------------------------------------------------- ISSUE 13: recipe knobs
+# DPTPU_BATCH_RAMP / DPTPU_WARMUP_POLY (parse in dptpu/ops/schedules.py,
+# wiring + composition fail-fasts in fit) under the same locked contract.
+
+
+@pytest.fixture()
+def _clean_recipe_env(monkeypatch):
+    for k in ("DPTPU_BATCH_RAMP", "DPTPU_WARMUP_POLY", "DPTPU_OVERLAP",
+              "DPTPU_BUCKET_MB", "DPTPU_DIST_EVAL",
+              "DPTPU_STRAGGLER_FACTOR"):
+        monkeypatch.delenv(k, raising=False)
+    return monkeypatch
+
+
+def test_parse_batch_ramp_happy_path():
+    from dptpu.ops.schedules import parse_batch_ramp, ramp_multiplier
+
+    ramp = parse_batch_ramp("4:2,8:4")
+    assert ramp == [(0, 1), (4, 2), (8, 4)]  # implied epoch-0 phase
+    assert [ramp_multiplier(ramp, e) for e in (0, 3, 4, 7, 8, 99)] == \
+        [1, 1, 2, 2, 4, 4]
+
+
+def test_parse_batch_ramp_explicit_epoch0():
+    from dptpu.ops.schedules import parse_batch_ramp
+
+    assert parse_batch_ramp("0:2,5:4") == [(0, 2), (5, 4)]
+
+
+@pytest.mark.parametrize("bad", ["junk", "4", "4:", ":2", "4:0", "-1:2",
+                                 "4:2,4:3", "8:2,4:4", "", " , "])
+def test_parse_batch_ramp_malformed_raises(bad):
+    from dptpu.ops.schedules import parse_batch_ramp
+
+    with pytest.raises(ValueError, match="DPTPU_BATCH_RAMP"):
+        parse_batch_ramp(bad)
+
+
+def test_fit_warmup_poly_invalid_raises(_clean_recipe_env):
+    from dptpu.train.fit import fit
+
+    _clean_recipe_env.setenv("DPTPU_WARMUP_POLY", "0")
+    cfg = Config(data="synthetic:16", arch="resnet18", batch_size=8,
+                 epochs=1, warmup_epochs=0)
+    with pytest.raises(ValueError, match="DPTPU_WARMUP_POLY"):
+        fit(cfg, image_size=32, verbose=False)
+
+
+def test_fit_warmup_poly_needs_warmup(_clean_recipe_env):
+    from dptpu.train.fit import fit
+
+    _clean_recipe_env.setenv("DPTPU_WARMUP_POLY", "2")
+    cfg = Config(data="synthetic:16", arch="resnet18", batch_size=8,
+                 epochs=1, warmup_epochs=0)
+    with pytest.raises(ValueError, match="--warmup-epochs"):
+        fit(cfg, image_size=32, verbose=False)
+
+
+def test_fit_batch_ramp_needs_warmup(_clean_recipe_env):
+    from dptpu.train.fit import fit
+
+    _clean_recipe_env.setenv("DPTPU_BATCH_RAMP", "1:2")
+    cfg = Config(data="synthetic:16", arch="resnet18", batch_size=8,
+                 epochs=2, warmup_epochs=0)
+    with pytest.raises(ValueError, match="DPTPU_BATCH_RAMP"):
+        fit(cfg, image_size=32, verbose=False)
+
+
+def test_fit_batch_ramp_beyond_epochs_raises(_clean_recipe_env):
+    from dptpu.train.fit import fit
+
+    _clean_recipe_env.setenv("DPTPU_BATCH_RAMP", "5:2")
+    cfg = Config(data="synthetic:16", arch="resnet18", batch_size=8,
+                 epochs=3, warmup_epochs=1)
+    with pytest.raises(ValueError, match="--epochs"):
+        fit(cfg, image_size=32, verbose=False)
+
+
+def test_fit_batch_ramp_straggler_composition_raises(_clean_recipe_env):
+    from dptpu.train.fit import fit
+
+    _clean_recipe_env.setenv("DPTPU_BATCH_RAMP", "1:2")
+    _clean_recipe_env.setenv("DPTPU_STRAGGLER_FACTOR", "2.0")
+    cfg = Config(data="synthetic:64", arch="resnet18", batch_size=16,
+                 epochs=3, warmup_epochs=1)
+    with pytest.raises(ValueError, match="DPTPU_STRAGGLER_FACTOR"):
+        fit(cfg, image_size=32, verbose=False)
+
+
+def test_fit_batch_ramp_tp_composition_names_alternatives(
+        _clean_recipe_env):
+    from dptpu.train.fit import fit
+
+    _clean_recipe_env.setenv("DPTPU_BATCH_RAMP", "1:2")
+    _clean_recipe_env.setenv("DPTPU_TP", "2")
+    cfg = Config(data="synthetic:64", arch="vit_b_32", batch_size=16,
+                 epochs=3, warmup_epochs=1)
+    with pytest.raises(ValueError) as ei:
+        fit(cfg, image_size=32, verbose=False)
+    msg = str(ei.value)
+    assert "DPTPU_BATCH_RAMP" in msg and "DPTPU_TP" in msg
+    assert "unset" in msg  # both alternatives spelled out
+
+
+def test_poly_power_one_is_linear_warmup():
+    """DPTPU_WARMUP_POLY=1 must be bit-identical to the linear ramp —
+    the power path is never traced at p=1 (dptpu/ops/schedules.py)."""
+    import numpy as np
+
+    from dptpu.ops.schedules import make_warmup_cosine_schedule
+
+    lin = make_warmup_cosine_schedule(2.0, 10, 4, 1)
+    p1 = make_warmup_cosine_schedule(2.0, 10, 4, 1, power=1.0)
+    for step in range(40):
+        np.testing.assert_array_equal(np.asarray(lin(step)),
+                                      np.asarray(p1(step)))
+
+
+def test_poly_power_two_bends_warmup():
+    import numpy as np
+
+    from dptpu.ops.schedules import make_warmup_cosine_schedule
+
+    lin = make_warmup_cosine_schedule(2.0, 10, 4, 2)
+    p2 = make_warmup_cosine_schedule(2.0, 10, 4, 2, power=2.0)
+    # polynomial warmup sits strictly below linear mid-ramp ...
+    assert float(p2(5)) < float(lin(5))
+    # ... and both land on the same peak / cosine tail
+    np.testing.assert_allclose(float(p2(30)), float(lin(30)), rtol=1e-6)
+
+
+def test_ramp_phase_schedule_is_continuous_at_boundary():
+    """The phase schedule chains in fractional epochs: the epoch the
+    ramp fires, the NEW phase's schedule evaluated at the boundary step
+    equals the old phase's trajectory at the same epoch, scaled x mult
+    (the linear-scaling jump is the ONLY discontinuity)."""
+    from dptpu.ops.schedules import make_ramp_phase_schedule
+
+    spe0, spe1 = 8, 4  # phase 1 has half the steps (double batch)
+    s0 = make_ramp_phase_schedule(1.0, spe0, 10, 2, epoch0=0, step0=0)
+    s1 = make_ramp_phase_schedule(2.0, spe1, 10, 2, epoch0=4,
+                                  step0=4 * spe0)
+    boundary = 4 * spe0
+    lr_old = float(s0(boundary))       # what phase 0 would have taken
+    lr_new = float(s1(boundary))       # what phase 1 actually takes
+    assert abs(lr_new - 2.0 * lr_old) < 2.0 * 0.02  # x mult, same shape

@@ -1,0 +1,59 @@
+"""Tier-1 smoke of scripts/run_racebench.py (the obsbench pattern):
+the overlap engine's race-harness gates — params Δ=0 parity against
+the unbucketed step, the simulated-pod overlap win (overlapped step <
+serial step at the modeled DCN bandwidth, on BOTH compute anchors),
+the bucketing-vs-per-leaf latency-amortization win, and the HLO
+schedule evidence — are continuously checked, not just on the bench
+host. One subprocess, --smoke preset, same gate logic as the committed
+RACEBENCH.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_racebench_smoke_gates(tmp_path):
+    out = str(tmp_path / "RACEBENCH.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_racebench.py"),
+         "--smoke", "--out", out],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (
+        f"racebench gate failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+    with open(out) as f:
+        bench = json.load(f)
+    # artifact schema: every consumer-facing section present
+    for key in ("simulated_pod", "hlo_evidence", "parity", "gates",
+                "measured_step_s", "model_assumptions", "local_caveat",
+                "grad_bytes", "host"):
+        assert key in bench, key
+    gates = bench["gates"]
+    assert gates["parity_ok"], bench["parity"]
+    assert gates["overlap_win_ok"]
+    assert gates["bucketing_win_ok"]
+    assert gates["evidence_ok"], bench["hlo_evidence"]
+    # the Δ=0 claim specifically, per overlap arm
+    deltas = [v for k, v in bench["parity"].items()
+              if k.endswith("_max_delta")]
+    assert deltas and all(d == 0.0 for d in deltas)
+    # the model rows cover both compute anchors, and the chip-equivalent
+    # headline actually shows a speedup > 1
+    anchors = {r["compute_anchor"] for r in bench["simulated_pod"]}
+    assert anchors == {"measured_host", "chip_equivalent"}
+    head = next(r for r in bench["simulated_pod"]
+                if r["compute_anchor"] == "chip_equivalent")
+    assert head["overlapped_ms"] < head["serial_ms"]
+    assert head["speedup"] > 1.0
+    # evidence: >= 2 interleaved per-bucket reductions in every arm
+    for ev in bench["hlo_evidence"].values():
+        assert ev["reductions"] >= 2
+        assert ev["interleaved_gaps"] >= 1
