@@ -1,6 +1,15 @@
 """Repo-invariant static analysis — ``dptpu check``.
 
-Two halves (ISSUE 12):
+Three parts (ISSUE 12 + the ISSUE 14 concurrency analyzer):
+
+* **Concurrency rules** (:mod:`dptpu.analysis.concurrency`):
+  ``guarded-by`` (shared mutable attributes of thread-spawning /
+  lock-owning classes must be annotated and lock-held on every access),
+  ``lock-order`` (acquisition-graph ABBA/cycle detection + the declared
+  ``LOCK_RANKS`` order), and ``thread-hygiene`` (joinable non-daemon
+  threads, census-attributable names, predicate-looped
+  ``Condition.wait``, no join-under-lock). The runtime mirror is
+  ``DPTPU_SYNC_CHECK=1`` (dptpu/utils/sync.py).
 
 * **AST lint engine** (:mod:`dptpu.analysis.lint`, rules in
   :mod:`dptpu.analysis.rules`): stdlib-``ast`` lints for the contracts

@@ -40,9 +40,55 @@ def build_check_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", default=None, metavar="PATH",
                    help="also write the machine-readable report "
                         "(ANALYSIS.json format) to PATH")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only files changed vs git (diff + staged "
+                        "+ untracked) — the seconds-fast pre-commit "
+                        "mode (scripts/precommit.sh); implies lint-only "
+                        "semantics for file selection, HLO gates still "
+                        "run unless --no-hlo")
+    p.add_argument("--files", nargs="*", default=None, metavar="PATH",
+                   help="explicit repo-relative file list to lint "
+                        "instead of the git diff (use with "
+                        "--changed-only)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="print findings only, no summary")
     return p
+
+
+def _changed_python_files(root: str) -> list:
+    """Repo-relative .py files changed vs git: unstaged + staged +
+    untracked, restricted to the scan roots. Raises RuntimeError when
+    git is unusable (the caller turns that into exit 2 — a broken diff
+    must never report 'clean over zero files')."""
+    import subprocess
+
+    from dptpu.analysis.lint import DEFAULT_SCAN_ROOTS
+
+    def run(*args):
+        try:
+            proc = subprocess.run(
+                ["git", *args], cwd=root, capture_output=True, text=True,
+                timeout=30,
+            )
+        except subprocess.SubprocessError as e:
+            # TimeoutExpired etc. — normalize so the caller's exit-2
+            # path handles a hung git like a failed one
+            raise RuntimeError(f"git {' '.join(args)}: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.returncode}"
+            )
+        return [ln.strip() for ln in proc.stdout.splitlines()
+                if ln.strip()]
+
+    names = set(run("diff", "--name-only", "HEAD"))
+    names |= set(run("ls-files", "--others", "--exclude-standard"))
+    return sorted(
+        n for n in names
+        if n.endswith(".py") and n.startswith(
+            tuple(f"{d}/" for d in DEFAULT_SCAN_ROOTS))
+    )
 
 
 def main_check(argv=None) -> int:
@@ -59,6 +105,15 @@ def main_check(argv=None) -> int:
             "--update-hlo-budgets needs the HLO gates it re-commits — "
             "drop --no-hlo"
         )
+    if args.files is not None and not args.changed_only:
+        parser.error("--files only makes sense with --changed-only")
+    if args.changed_only and (args.update_hlo_budgets or args.json):
+        # the committed ANALYSIS.json baseline and the budget table are
+        # whole-repo artifacts; a partial scan must never overwrite them
+        parser.error(
+            "--changed-only is the partial pre-commit mode — "
+            "--json/--update-hlo-budgets need the full scan"
+        )
     root = args.root
     if not any(os.path.isdir(os.path.join(root, d))
                for d in DEFAULT_SCAN_ROOTS):
@@ -70,6 +125,57 @@ def main_check(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.changed_only:
+        from dptpu.analysis.lint import lint_paths
+
+        if args.files is not None:
+            if not args.files:
+                # an empty explicit list (e.g. a shell expansion that
+                # matched nothing) must not report "clean over zero
+                # files" — same contract as the wrong-root guard
+                print(
+                    "dptpu check: --files got an empty list — pass the "
+                    "paths to lint (or drop --files to diff against "
+                    "git)", file=sys.stderr,
+                )
+                return 2
+            files = sorted(args.files)
+            missing = [f for f in files
+                       if not os.path.isfile(os.path.join(root, f))]
+            if missing:
+                print(
+                    f"dptpu check: --files names missing paths: "
+                    f"{', '.join(missing)}", file=sys.stderr,
+                )
+                return 2
+        else:
+            try:
+                files = _changed_python_files(root)
+            except (RuntimeError, OSError) as e:
+                print(f"dptpu check: cannot diff against git ({e}) — "
+                      f"run the full check instead", file=sys.stderr)
+                return 2
+            files = [f for f in files
+                     if os.path.isfile(os.path.join(root, f))]
+        findings, suppressions = lint_paths(root, files)
+        for f in findings:
+            print(f.format())
+        ok = not findings
+        if not args.no_hlo:
+            from dptpu.analysis.hlo_budget import check_hlo_budgets
+
+            violations, _ = check_hlo_budgets(root)
+            for v in violations:
+                print(v.format())
+            ok = ok and not violations
+        if not args.quiet:
+            print(
+                f"=> dptpu check --changed-only: {len(files)} changed "
+                f"file(s), {len(findings)} finding(s), "
+                f"{len(suppressions)} reasoned suppression(s) — "
+                f"{'clean' if ok else 'NOT CLEAN'}"
+            )
+        return 0 if ok else 1
     computed = None
     if args.update_hlo_budgets:
         from dptpu.analysis.hlo_budget import (

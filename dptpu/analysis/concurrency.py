@@ -1,0 +1,844 @@
+"""Concurrency lint rules: ``guarded-by`` / ``lock-order`` / ``thread-hygiene``.
+
+The host side of dptpu is a hand-rolled concurrent system — the serve
+dispatcher, the async checkpoint writer, the shard-extent prefetcher,
+the seqlock'd pooled cache, signal handlers — and until ISSUE 14 the
+only thing standing between it and a silent data race was test luck.
+These rules machine-check the lock discipline the same way TSan /
+Guava's ``@GuardedBy`` checkers do in mature training stacks:
+
+* ``guarded-by`` — a class that spawns threads (or hands callbacks to
+  them: ``Thread(target=...)``, executor ``submit``, ``atexit`` /
+  ``signal`` registration) or that owns a lock must ANNOTATE its shared
+  mutable attributes::
+
+      self._completed = 0      # guarded-by: _lock
+      self.requested = False   # owned-by: signal-handler
+
+  The rule builds per-class attribute read/write maps from the AST,
+  computes which methods run on a spawned thread (reachability from the
+  thread-entry points) vs. the calling thread, and reports: shared
+  mutable attributes with no annotation, ``guarded-by`` attributes
+  touched anywhere without the named lock held (``with``-statement
+  scope tracking; methods suffixed ``_locked`` are held-by-contract,
+  and calls to them must themselves be made under a lock), annotations
+  naming nonexistent locks, and ``owned-by`` state written from both
+  sides (single-writer is the whole point of the annotation).
+  ``__init__``/``__del__``/pickling dunders are exempt (pre-publication
+  and teardown are single-threaded by construction).
+
+* ``lock-order`` — a whole-file lock acquisition graph: nested ``with
+  lock:`` scopes, plus call edges (a method called while holding A
+  contributes every lock it acquires as A -> B). Any cycle is a
+  potential ABBA deadlock and a finding; so is re-acquiring a
+  non-reentrant lock on a path that already holds it, and an edge that
+  inverts the declared :data:`dptpu.utils.sync.LOCK_RANKS` ranks.
+  ``OrderedLock("name")`` literals must name a declared rank.
+
+* ``thread-hygiene`` — non-daemon threads must have a reachable
+  ``join()`` on a teardown path (and dptpu-package threads must carry a
+  ``dptpu``-prefixed name so the conftest thread census can attribute a
+  leak); ``Condition.wait`` must sit in a predicate re-check loop; no
+  blocking ``join()`` while holding a lock.
+
+Static analysis is conservative where Python is dynamic: cross-CLASS
+lock nesting (object A holding its lock while calling into object B) is
+invisible here and is covered by the RUNTIME half instead —
+``DPTPU_SYNC_CHECK=1`` makes every ``OrderedLock`` assert the declared
+rank order on real executions (dptpu/utils/sync.py; tier-1 runs the
+whole suite under it). Stdlib-only, like the engine (dptpu.utils.sync
+is itself stdlib-only and safe to import here).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dptpu.analysis.lint import FileContext, register
+from dptpu.utils.sync import LOCK_RANKS
+
+# lock primitives a `with` statement can hold
+_HOLDABLE_CTORS = {"Lock", "RLock", "OrderedLock", "OrderedRLock",
+                   "ordered_mp_lock"}
+# anything whose presence declares "this class is concurrent"
+_MARKER_CTORS = _HOLDABLE_CTORS | {"Condition", "Event", "Semaphore",
+                                   "BoundedSemaphore", "Barrier"}
+_ORDERED_CTORS = {"OrderedLock", "OrderedRLock", "ordered_mp_lock"}
+# single-threaded-by-construction methods: pre-publication init,
+# interpreter-teardown del, spawn-boundary pickling
+_EXEMPT_METHODS = {"__init__", "__del__", "__getstate__", "__setstate__",
+                   "__reduce__"}
+
+_ANNOT_RE = re.compile(r"#\s*(guarded-by|owned-by):\s*([A-Za-z_][\w-]*)")
+
+
+def _qualname(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _qualname(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _last(q: Optional[str]) -> str:
+    return (q or "").rsplit(".", 1)[-1]
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' for a ``self.X`` attribute node."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
+    """Classify an assigned value: 'lock' / 'rlock' / 'cond' /
+    'collection' (a list/comprehension of locks) / 'marker' / None."""
+    if isinstance(value, ast.Call):
+        name = _last(_qualname(value.func))
+        if name in ("Lock", "OrderedLock", "ordered_mp_lock"):
+            return "lock"
+        if name in ("RLock", "OrderedRLock"):
+            return "rlock"
+        if name == "Condition":
+            return "cond"
+        if name in _MARKER_CTORS:
+            return "marker"
+        return None
+    if isinstance(value, (ast.List, ast.Tuple, ast.ListComp)):
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call) \
+                    and _last(_qualname(sub.func)) in _HOLDABLE_CTORS:
+                return "collection"
+    return None
+
+
+class _ClassConc:
+    """Everything the three rules need to know about one class."""
+
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {
+            m.name: m for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Dict[str, str] = {}   # attr -> kind
+        self.alias: Dict[str, str] = {}        # cond attr -> lock attr
+        self.ordered_names: Dict[str, str] = {}  # attr -> LOCK_RANKS name
+        self.markers = False
+        self.entries: Set[str] = set()         # entry regions
+        self.entry_lines: Dict[str, int] = {}
+        # (attr, 'load'|'store', held, region, line)
+        self.accesses: List[Tuple[str, str, frozenset, str, int]] = []
+        # (callee, held, region, line) — self.<callee>() calls
+        self.calls: List[Tuple[str, frozenset, str, int]] = []
+        # (held-lock, acquired-lock, line) lexical nesting edges
+        self.nest_edges: List[Tuple[str, str, int]] = []
+        # region -> locks lexically acquired in it
+        self.acquired_in: Dict[str, Set[str]] = {}
+        # (lockname, region, line) same-lock nested acquisition
+        self.reacquisitions: List[Tuple[str, str, int]] = []
+        # (held-locks, line) for every *.join(...) call
+        self.join_calls: List[Tuple[frozenset, int]] = []
+        # (region, line, loop_depth) for every <cond>.wait(...) call
+        self.cond_waits: List[Tuple[str, int, int]] = []
+        self._nested_thread_defs: Dict[int, str] = {}
+        # attr -> (kind, value, line), filled by _analyze (with
+        # same-file base-class inheritance)
+        self.annotations: Dict[str, Tuple[str, str, int]] = {}
+        self.annotation_conflicts: List[Tuple[int, str]] = []
+
+    # -- pass 1: locks, markers, thread entries --------------------------
+
+    def scan_decls(self):
+        for stmt in ast.walk(self.node):
+            if isinstance(stmt, ast.Assign):
+                kind = _lock_ctor_kind(stmt.value)
+                if kind is None:
+                    continue
+                for tgt in stmt.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    self.markers = True
+                    if kind == "marker":
+                        continue
+                    self.lock_attrs[attr] = kind
+                    if kind == "cond" and isinstance(stmt.value, ast.Call) \
+                            and stmt.value.args:
+                        under = _self_attr(stmt.value.args[0])
+                        if under is not None:
+                            self.alias[attr] = under
+                    if kind in ("lock", "rlock") \
+                            and isinstance(stmt.value, ast.Call):
+                        ctor = _last(_qualname(stmt.value.func))
+                        if ctor in _ORDERED_CTORS and stmt.value.args:
+                            arg = stmt.value.args[0]
+                            if isinstance(arg, ast.Constant) \
+                                    and isinstance(arg.value, str):
+                                self.ordered_names[attr] = arg.value
+        for mname, mnode in self.methods.items():
+            nested = {
+                n.name: n for n in ast.walk(mnode)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not mnode
+            }
+            for call in ast.walk(mnode):
+                if not isinstance(call, ast.Call):
+                    continue
+                target = _thread_callback(call)
+                if target is None:
+                    continue
+                attr = _self_attr(target)
+                if attr is not None:
+                    self.entries.add(attr)
+                    self.entry_lines.setdefault(attr, call.lineno)
+                elif isinstance(target, ast.Name) \
+                        and target.id in nested:
+                    region = f"{mname}:{target.id}"
+                    self.entries.add(region)
+                    self.entry_lines.setdefault(region, call.lineno)
+                    self._nested_thread_defs[id(nested[target.id])] = region
+
+    def canon(self, lock: str) -> str:
+        return self.alias.get(lock, lock)
+
+    def holdable(self, attr: str) -> bool:
+        kind = self.lock_attrs.get(attr)
+        return kind in ("lock", "rlock", "cond")
+
+    # -- pass 2: accesses / calls / edges under with-scope tracking ------
+
+    def scan_bodies(self):
+        for mname, mnode in self.methods.items():
+            self._visit(mnode, frozenset(), mname, loop_depth=0,
+                        top=True)
+
+    def _visit(self, node, held: frozenset, region: str, loop_depth: int,
+               top: bool = False):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and not top:
+            # a nested def's body runs LATER, on whatever thread calls
+            # it: the lexical locks are not held there
+            region = self._nested_thread_defs.get(id(node), region)
+            held = frozenset()
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = set(held)
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and self.holdable(attr):
+                    lock = self.canon(attr)
+                    if lock in new and \
+                            self.lock_attrs.get(lock) != "rlock":
+                        self.reacquisitions.append(
+                            (lock, region, node.lineno)
+                        )
+                    for h in new:
+                        if h != lock:
+                            self.nest_edges.append((h, lock, node.lineno))
+                    new.add(lock)
+                    self.acquired_in.setdefault(region, set()).add(lock)
+            for item in node.items:
+                self._visit(item.context_expr, held, region, loop_depth)
+            for child in node.body:
+                self._visit(child, frozenset(new), region, loop_depth)
+            return
+        if isinstance(node, ast.While):
+            loop_depth += 1
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                kind = "store" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "load"
+                self.accesses.append(
+                    (attr, kind, held, region, node.lineno)
+                )
+        if isinstance(node, (ast.Subscript,)) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            # container mutation through a self attribute
+            # (self.X[k] = v / del self.X[k]) is a WRITE to X
+            base = node.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr is not None:
+                self.accesses.append(
+                    (attr, "store", held, region, node.lineno)
+                )
+        if isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr is not None and attr in self.methods:
+                self.calls.append((attr, held, region, node.lineno))
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                self.join_calls.append((held, node.lineno))
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "wait":
+                cattr = _self_attr(node.func.value)
+                if cattr is not None \
+                        and self.lock_attrs.get(cattr) == "cond":
+                    self.cond_waits.append(
+                        (region, node.lineno, loop_depth)
+                    )
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, region, loop_depth)
+
+    # -- sides -----------------------------------------------------------
+
+    def sides(self) -> Tuple[Set[str], Set[str]]:
+        """(thread-side regions, caller-side regions)."""
+        callee_edges: Dict[str, Set[str]] = {}
+        in_edges: Set[str] = set()
+        for callee, _held, region, _line in self.calls:
+            callee_edges.setdefault(region, set()).add(callee)
+            in_edges.add(callee)
+
+        def closure(roots):
+            seen = set(roots)
+            todo = list(roots)
+            while todo:
+                r = todo.pop()
+                for c in callee_edges.get(r, ()):
+                    if c not in seen:
+                        seen.add(c)
+                        todo.append(c)
+            return seen
+
+        tr = closure(self.entries)
+        roots = {
+            m for m in self.methods
+            if m not in self.entries and m not in in_edges
+        }
+        cr = closure(roots)
+        # a method reachable from nothing we can see is still a public
+        # entry point in waiting: presume caller-side
+        for m in self.methods:
+            if m not in tr and m not in cr:
+                cr.add(m)
+        return tr, cr
+
+
+def _thread_callback(call: ast.Call) -> Optional[ast.AST]:
+    """The callable handed to another thread by this call, if any:
+    Thread(target=X) / Timer(t, X) / <executor>.submit(X, ...) /
+    atexit.register(X) / signal.signal(sig, X)."""
+    q = _qualname(call.func)
+    name = _last(q)
+    if name == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if name == "Timer":
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+        return None
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "submit" \
+            and call.args:
+        return call.args[0]
+    if q == "atexit.register" and call.args:
+        return call.args[0]
+    if q and q.endswith("signal.signal") and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _class_annotations(ctx: FileContext, cls: _ClassConc
+                       ) -> Dict[int, Tuple[str, str]]:
+    """line -> (kind, value) for guarded-by/owned-by comments inside the
+    class body."""
+    end = getattr(cls.node, "end_lineno", None) or cls.node.lineno
+    out: Dict[int, Tuple[str, str]] = {}
+    lines = ctx.source.splitlines()
+    for lineno in range(cls.node.lineno, min(end, len(lines)) + 1):
+        m = _ANNOT_RE.search(lines[lineno - 1])
+        if m:
+            out[lineno] = (m.group(1), m.group(2))
+    return out
+
+
+def _analyze(ctx: FileContext) -> List[_ClassConc]:
+    cached = getattr(ctx, "_concurrency_classes", None)
+    if cached is not None:
+        return cached
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            cls = _ClassConc(node)
+            cls.scan_decls()
+            out.append(cls)
+    # same-file inheritance: a subclass holds (and is concurrent via)
+    # its base's locks and inherits its attribute annotations —
+    # HTTPStore riding Store._lock and Store's guarded-by declarations
+    # must see both. Iterate to convergence for base chains.
+    by_name = {c.name: c for c in out}
+    for cls in out:
+        cls.annotations = _bind_annotations(ctx, cls)
+    changed = True
+    while changed:
+        changed = False
+        for cls in out:
+            for base in cls.node.bases:
+                bname = _last(_qualname(base))
+                parent = by_name.get(bname)
+                if parent is None or parent is cls:
+                    continue
+                for attr, kind in parent.lock_attrs.items():
+                    if attr not in cls.lock_attrs:
+                        cls.lock_attrs[attr] = kind
+                        changed = True
+                for cattr, under in parent.alias.items():
+                    if cattr not in cls.alias:
+                        cls.alias[cattr] = under
+                        changed = True
+                for attr, name in parent.ordered_names.items():
+                    if attr not in cls.ordered_names:
+                        cls.ordered_names[attr] = name
+                        changed = True
+                if parent.markers and not cls.markers:
+                    cls.markers = True
+                    changed = True
+                for attr, entry in parent.annotations.items():
+                    if attr not in cls.annotations:
+                        cls.annotations[attr] = entry
+                        changed = True
+    for cls in out:
+        cls.scan_bodies()
+    ctx._concurrency_classes = out
+    return out
+
+
+def _bind_annotations(ctx: FileContext, cls: _ClassConc
+                      ) -> Dict[str, Tuple[str, str, int]]:
+    """attr -> (kind, value, line): the guarded-by/owned-by comments
+    bound to this class's own attribute stores. Needs a quick store
+    scan of its own because it runs BEFORE scan_bodies (inheritance
+    merging wants annotations early)."""
+    annot_lines = _class_annotations(ctx, cls)
+    if not annot_lines:
+        return {}
+    out: Dict[str, Tuple[str, str, int]] = {}
+    conflicts: List[Tuple[int, str]] = []
+    for node in ast.walk(cls.node):
+        attr = None
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr(node)
+        if attr is None or node.lineno not in annot_lines:
+            continue
+        akind, aval = annot_lines[node.lineno]
+        prev = out.get(attr)
+        if prev is not None and (prev[0], prev[1]) != (akind, aval):
+            conflicts.append((node.lineno, (
+                f"attribute '{attr}' carries conflicting annotations "
+                f"('{prev[0]}: {prev[1]}' at line {prev[2]} vs "
+                f"'{akind}: {aval}') — keep exactly one"
+            )))
+            continue
+        out[attr] = (akind, aval, node.lineno)
+    cls.annotation_conflicts = conflicts
+    return out
+
+
+def _in_package(relpath: str) -> bool:
+    return relpath.startswith(("dptpu/", "scripts/"))
+
+
+# -------------------------------------------------------------- guarded-by
+
+
+@register(
+    "guarded-by", _in_package,
+    "classes that spawn threads (or hand callbacks to them) or own "
+    "locks must annotate shared mutable attributes with "
+    "'# guarded-by: <lock-attr>' (every access lock-held, "
+    "with-statement scope tracking, *_locked held-by-contract) or "
+    "'# owned-by: <thread-role>' (single-writer handoff state); stale "
+    "annotations naming nonexistent locks are findings too",
+)
+def guarded_by(ctx: FileContext) -> Iterator[Tuple[int, str]]:
+    for cls in _analyze(ctx):
+        concurrent = bool(cls.entries or cls.lock_attrs or cls.markers)
+        annotations = cls.annotations
+        yield from cls.annotation_conflicts
+        # stale guarded-by: the named lock must exist (checked even in
+        # classes this rule otherwise skips — a stale name is never ok)
+        for attr, (akind, aval, line) in sorted(annotations.items()):
+            if akind == "guarded-by" and not cls.holdable(aval):
+                yield line, (
+                    f"attribute '{attr}' is declared guarded-by "
+                    f"'{aval}' but class {cls.name} has no such lock "
+                    f"attribute (known locks: "
+                    f"{', '.join(sorted(cls.lock_attrs)) or 'none'}) — "
+                    f"stale annotation?"
+                )
+        if not concurrent:
+            continue
+        tr, cr = cls.sides()
+
+        def side_of(region: str) -> Tuple[bool, bool]:
+            return (region in tr, region in cr)
+
+        writes_outside: Dict[str, int] = {}
+        first_init_store: Dict[str, int] = {}
+        touched_tr: Set[str] = set()
+        touched_cr: Set[str] = set()
+        writes_tr: Dict[str, int] = {}
+        writes_cr: Dict[str, int] = {}
+        for attr, kind, _held, region, line in cls.accesses:
+            if attr in cls.lock_attrs:
+                continue
+            method = region.split(":", 1)[0]
+            if method in _EXEMPT_METHODS:
+                if kind == "store" and method == "__init__" \
+                        and attr not in first_init_store:
+                    first_init_store[attr] = line
+                continue
+            is_tr, is_cr = side_of(region)
+            if is_tr:
+                touched_tr.add(attr)
+            if is_cr:
+                touched_cr.add(attr)
+            if kind == "store":
+                if attr not in writes_outside:
+                    writes_outside[attr] = line
+                if is_tr and attr not in writes_tr:
+                    writes_tr[attr] = line
+                if is_cr and attr not in writes_cr:
+                    writes_cr[attr] = line
+        if cls.entries:
+            shared = {
+                a for a in writes_outside
+                if a in touched_tr and a in touched_cr
+            }
+        else:
+            # no visible spawn point, but the class declared itself
+            # concurrent by owning a lock: every mutated attribute is
+            # presumed reachable from multiple threads
+            shared = set(writes_outside)
+        for attr in sorted(shared):
+            if attr in annotations:
+                continue
+            line = first_init_store.get(attr, writes_outside[attr])
+            if cls.entries:
+                detail = ("is touched from both a spawned thread and "
+                          "the caller thread")
+            else:
+                detail = (f"is mutated in lock-owning class {cls.name}")
+            yield line, (
+                f"shared mutable attribute '{attr}' {detail} with no "
+                f"concurrency annotation — declare "
+                f"'# guarded-by: <lock-attr>' on an assignment of it "
+                f"(or '# owned-by: <thread-role>' for single-writer "
+                f"handoff state); see CONCURRENCY.md"
+            )
+        # guarded-by enforcement: EVERY non-exempt access lock-held
+        for attr, (akind, aval, _line) in sorted(annotations.items()):
+            if akind == "guarded-by" and cls.holdable(aval):
+                want = cls.canon(aval)
+                for a, kind, held, region, line in cls.accesses:
+                    if a != attr:
+                        continue
+                    method = region.split(":", 1)[0]
+                    if method in _EXEMPT_METHODS:
+                        continue
+                    if method.endswith("_locked"):
+                        continue
+                    if want in held:
+                        continue
+                    yield line, (
+                        f"'{attr}' is declared guarded-by '{aval}' but "
+                        f"{method}() touches it without the lock held — "
+                        f"wrap the access in 'with self.{aval}:' or "
+                        f"move it into a *_locked helper that is only "
+                        f"called under the lock"
+                    )
+            elif akind == "owned-by" and cls.entries:
+                if attr in writes_tr and attr in writes_cr:
+                    yield writes_outside[attr], (
+                        f"'{attr}' is declared owned-by '{aval}' but is "
+                        f"written from BOTH a spawned thread (line "
+                        f"{writes_tr[attr]}) and the caller thread "
+                        f"(line {writes_cr[attr]}) — single-writer "
+                        f"handoff state has exactly one writing side; "
+                        f"guard it with a lock instead"
+                    )
+        # the *_locked contract: such helpers may elide the with-block
+        # only because every CALL to them already holds a lock
+        for callee, held, region, line in cls.calls:
+            if not callee.endswith("_locked"):
+                continue
+            method = region.split(":", 1)[0]
+            if held or method.endswith("_locked") \
+                    or method in _EXEMPT_METHODS:
+                continue
+            yield line, (
+                f"call to {callee}() from {method}() with no lock held "
+                f"— the *_locked suffix means 'caller holds the lock'; "
+                f"acquire it first or rename the helper"
+            )
+
+
+# -------------------------------------------------------------- lock-order
+
+
+@register(
+    "lock-order", _in_package,
+    "whole-file lock acquisition graph (nested with-scopes + call "
+    "edges): acquisition cycles are potential ABBA deadlocks, "
+    "re-acquiring a non-reentrant lock on a holding path self-"
+    "deadlocks, edges must respect dptpu.utils.sync.LOCK_RANKS, and "
+    "OrderedLock names must be declared there",
+)
+def lock_order(ctx: FileContext) -> Iterator[Tuple[int, str]]:
+    # OrderedLock("name") literals must be declared ranks (repo-wide
+    # check, classes or not)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last(_qualname(node.func)) not in _ORDERED_CTORS:
+            continue
+        name_node = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "name"), None
+        )
+        name = ctx.resolve_str(name_node) if name_node is not None else None
+        if name is None:
+            yield node.lineno, (
+                "OrderedLock name is not statically resolvable — pass a "
+                "string literal so the analyzer (and CONCURRENCY.md) "
+                "can place it in the global order"
+            )
+        elif name not in LOCK_RANKS:
+            yield node.lineno, (
+                f"OrderedLock name {name!r} is not declared in "
+                f"dptpu/utils/sync.py LOCK_RANKS — declare its rank "
+                f"there and document it in CONCURRENCY.md (known: "
+                f"{', '.join(sorted(LOCK_RANKS))})"
+            )
+    for cls in _analyze(ctx):
+        # self-deadlock: same non-reentrant lock nested lexically
+        for lock, region, line in cls.reacquisitions:
+            yield line, (
+                f"{cls.name}.{region}() acquires '{lock}' while already "
+                f"holding it — a non-reentrant lock self-deadlocks here; "
+                f"restructure (or use OrderedRLock if re-entry is truly "
+                f"intended)"
+            )
+        # edges: lexical nesting + call edges
+        edges: List[Tuple[str, str, int]] = list(cls.nest_edges)
+        callee_edges: Dict[str, Set[str]] = {}
+        for callee, _held, region, _line in cls.calls:
+            callee_edges.setdefault(region, set()).add(callee)
+
+        def acquires_closure(method: str) -> Set[str]:
+            seen: Set[str] = set()
+            todo = [method]
+            visited = set()
+            while todo:
+                m = todo.pop()
+                if m in visited:
+                    continue
+                visited.add(m)
+                seen |= cls.acquired_in.get(m, set())
+                for c in callee_edges.get(m, ()):
+                    todo.append(c)
+            return seen
+
+        for callee, held, _region, line in cls.calls:
+            if not held:
+                continue
+            for lock in sorted(acquires_closure(callee)):
+                for h in held:
+                    if h == lock:
+                        if cls.lock_attrs.get(lock) != "rlock":
+                            yield line, (
+                                f"{cls.name}: calling {callee}() while "
+                                f"holding '{lock}', which {callee}() "
+                                f"re-acquires — a non-reentrant lock "
+                                f"self-deadlocks on this path"
+                            )
+                    else:
+                        edges.append((h, lock, line))
+        # cycle detection over the merged edge set
+        graph: Dict[str, Set[str]] = {}
+        edge_line: Dict[Tuple[str, str], int] = {}
+        for a, b, line in edges:
+            graph.setdefault(a, set()).add(b)
+            edge_line.setdefault((a, b), line)
+
+        def reachable(src: str, dst: str) -> bool:
+            seen, todo = set(), [src]
+            while todo:
+                n = todo.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                todo.extend(graph.get(n, ()))
+            return False
+
+        reported = set()
+        for (a, b), line in sorted(edge_line.items(),
+                                   key=lambda kv: kv[1]):
+            if (a, b) in reported:
+                continue
+            if reachable(b, a):
+                reported.add((a, b))
+                reported.add((b, a))
+                yield line, (
+                    f"{cls.name}: potential ABBA deadlock — '{b}' is "
+                    f"acquired here while holding '{a}', but another "
+                    f"path acquires '{a}' while holding '{b}' (line "
+                    f"{edge_line.get((b, a), '?')}); pick ONE global "
+                    f"order (dptpu/utils/sync.py LOCK_RANKS, "
+                    f"CONCURRENCY.md) and restructure the inverted side"
+                )
+        # declared-rank consistency on the visible edges
+        for (a, b), line in sorted(edge_line.items(),
+                                   key=lambda kv: kv[1]):
+            ra = cls.ordered_names.get(a)
+            rb = cls.ordered_names.get(b)
+            if ra in LOCK_RANKS and rb in LOCK_RANKS \
+                    and LOCK_RANKS[ra] >= LOCK_RANKS[rb]:
+                yield line, (
+                    f"{cls.name}: acquiring '{b}' (rank "
+                    f"{LOCK_RANKS[rb]}, {rb!r}) while holding '{a}' "
+                    f"(rank {LOCK_RANKS[ra]}, {ra!r}) inverts the "
+                    f"declared LOCK_RANKS order — swap the nesting or "
+                    f"re-rank in dptpu/utils/sync.py"
+                )
+
+
+# ---------------------------------------------------------- thread-hygiene
+
+
+def _scope_has_join(scope: ast.AST, recv: Optional[str]) -> bool:
+    """Does ``scope`` contain a ``<recv>.join(...)`` call (any receiver
+    when ``recv`` is None — threads stored into containers)?"""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            if recv is None:
+                return True
+            got = _qualname(node.func.value)
+            if got == recv:
+                return True
+    return False
+
+
+@register(
+    "thread-hygiene", _in_package,
+    "non-daemon threads need a reachable join() on a teardown path "
+    "(and dptpu-package threads a dptpu-prefixed name for the conftest "
+    "thread census); Condition.wait sits in a predicate re-check "
+    "loop; no blocking join() while holding a lock",
+)
+def thread_hygiene(ctx: FileContext) -> Iterator[Tuple[int, str]]:
+    tree = ctx.tree
+    # parent scopes for every node: nearest enclosing function + class
+    scope_of: Dict[int, Tuple[Optional[ast.AST], Optional[ast.AST]]] = {}
+
+    def map_scopes(node, func, cls):
+        scope_of[id(node)] = (func, cls)
+        nfunc, ncls = func, cls
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nfunc = node
+        elif isinstance(node, ast.ClassDef):
+            ncls = node
+            nfunc = None
+        for child in ast.iter_child_nodes(node):
+            map_scopes(child, nfunc, ncls)
+
+    map_scopes(tree, None, None)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last(_qualname(node.func)) != "Thread":
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        # census attribution: dptpu-package threads carry a dptpu name
+        if ctx.relpath.startswith("dptpu/"):
+            name = ctx.resolve_str(kwargs["name"]) \
+                if "name" in kwargs else None
+            if name is None or not name.startswith("dptpu"):
+                yield node.lineno, (
+                    "thread without a 'dptpu'-prefixed name= — the "
+                    "conftest thread census attributes leaks by name; "
+                    "pass name='dptpu-<role>'"
+                )
+        daemon = kwargs.get("daemon")
+        is_daemon = (isinstance(daemon, ast.Constant)
+                     and daemon.value is True)
+        if is_daemon:
+            continue
+        func, cls = scope_of.get(id(node), (None, None))
+        # where did the Thread object land? self-attr / local / nowhere
+        recv = None
+        search: Optional[ast.AST] = func or cls or tree
+        parentage = _assignment_target(tree, node)
+        if parentage is not None:
+            attr = _self_attr(parentage)
+            if attr is not None and cls is not None:
+                recv = f"self.{attr}"
+                search = cls
+            elif isinstance(parentage, ast.Name):
+                recv = parentage.id
+                search = func or cls or tree
+            else:
+                recv = None  # container (list of threads): any join ok
+        if search is None or not _scope_has_join(search, recv):
+            yield node.lineno, (
+                "non-daemon thread with no reachable join() in its "
+                "owning scope — join it on a teardown path (close()/"
+                "finally) or pass daemon=True; a leaked non-daemon "
+                "thread hangs interpreter exit and fails the conftest "
+                "thread census"
+            )
+    # Condition.wait predicate loops + join-under-lock, via the class
+    # analysis machinery
+    for cls in _analyze(ctx):
+        for region, line, loop_depth in cls.cond_waits:
+            if loop_depth < 1:
+                yield line, (
+                    f"{cls.name}.{region}(): Condition.wait() outside a "
+                    f"predicate re-check loop — spurious/stolen wakeups "
+                    f"make the condition a hint, not a fact; wrap it in "
+                    f"'while not <predicate>:'"
+                )
+        for held, line in cls.join_calls:
+            if held:
+                locks = ", ".join(sorted(held))
+                yield line, (
+                    f"{cls.name}: blocking join() while holding "
+                    f"'{locks}' — a thread that needs that lock to "
+                    f"finish can never finish (deadlock); release "
+                    f"before joining"
+                )
+
+
+def _assignment_target(tree: ast.AST, call: ast.Call) -> Optional[ast.AST]:
+    """The Assign target that receives ``call``'s value, if the call is
+    the direct RHS (or sits inside a comprehension/list RHS — returns a
+    sentinel Attribute-free node so callers treat it as a container)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if node.value is call:
+                return node.targets[0]
+            for sub in ast.walk(node.value):
+                if sub is call and node.value is not call:
+                    # stored via a container expression
+                    return node.value
+    return None

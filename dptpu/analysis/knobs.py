@@ -89,6 +89,8 @@ KNOB_REGISTRY = {
     "DPTPU_SERVE_MAX_DELAY_MS": _k("float", "serve"),
     "DPTPU_SERVE_PLACEMENT": _k("choice", "serve"),
     "DPTPU_SERVE_SLOTS": _k("int", "serve"),
+    # analysis / sanitizers
+    "DPTPU_SYNC_CHECK": _k("bool", "analysis"),
     # bench-driver child sentinels (subprocess re-entry guards)
     "DPTPU_NUMERICS_CHILD": _k("str", "bench", internal=True),
     "DPTPU_SCALEBENCH_CHILD": _k("str", "bench", internal=True),

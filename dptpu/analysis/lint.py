@@ -172,8 +172,8 @@ def iter_rules() -> List[Rule]:
 
 def _load_rules():
     # rules self-register on import; deferred so lint.py has no import
-    # cycle with rules.py
-    from dptpu.analysis import rules  # noqa: F401
+    # cycle with rules.py / concurrency.py
+    from dptpu.analysis import concurrency, rules  # noqa: F401
 
 
 def _parse_pragmas(relpath: str, source: str):

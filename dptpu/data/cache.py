@@ -32,10 +32,11 @@ Thread-safe; stats (hits/misses/evictions/bytes) feed the loader's
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from dptpu.utils.sync import OrderedLock
 
 
 class DecodeCache:
@@ -58,13 +59,13 @@ class DecodeCache:
                 f"cache budget must be positive, got {budget_bytes} "
                 f"(omit the cache instead of zero-sizing it)"
             )
-        self.budget_bytes = int(budget_bytes)
-        self._entries: OrderedDict = OrderedDict()
-        self._bytes = 0
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self.budget_bytes = int(budget_bytes)  # guarded-by: _lock
+        self._entries: OrderedDict = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._lock = OrderedLock("data.decode_cache")
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
 
     # -- core ---------------------------------------------------------------
 
@@ -117,10 +118,12 @@ class DecodeCache:
 
     @property
     def bytes_in_use(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def stats(self) -> dict:
         with self._lock:

@@ -168,7 +168,7 @@ class DataLoader:
         # through the shm pipeline's pre-issue pump.
         self._prefetch_extents = getattr(dataset, "prefetch_extents", None)
         self._item_shape = None  # probed from the first sample
-        self._probe = None  # (index, epoch, img, label) — reused for row 0
+        self._probe = None  # owned-by: caller — (index, epoch, img, label) probe, consumed at submit time
         self._pipeline = None  # lazy shm ring (process mode)
         self._prev_cache_counts = (0, 0)  # feed_stats interval baseline
         self._degraded = False  # process pool gave up → thread fallback
@@ -201,25 +201,20 @@ class DataLoader:
         rng = np.random.default_rng([self.seed, epoch, index])
         return self._get(index, rng)
 
-    def _load_span(self, idxs, epoch, imgs, labels, offset):
+    def _load_span(self, idxs, epoch, imgs, labels, offset, skip=()):
         """Decode a span of samples directly into rows
         ``offset..offset+len(idxs)`` of the shared batch arrays — the
         per-worker unit of a chunked submission (disjoint rows, so
-        concurrent spans never race)."""
+        concurrent spans never race). ``skip`` rows were already filled
+        by the caller (the shape probe's reused decode)."""
         from dptpu.data.dataset import _copy_checked
 
         get_into = self._get_into
         for j, index in enumerate(idxs):
             index = int(index)
-            probe = self._probe
-            if (probe is not None and probe[0] == index
-                    and probe[1] == epoch):
-                # the shape probe already decoded this exact sample with
-                # this exact rng — reuse it instead of decoding twice
-                self._probe = None
-                imgs[offset + j] = probe[2]
-                labels[offset + j] = probe[3]
-            elif get_into is not None:
+            if offset + j in skip:
+                continue
+            if get_into is not None:
                 rng = np.random.default_rng([self.seed, epoch, index])
                 labels[offset + j] = get_into(index, rng, imgs[offset + j])
             else:
@@ -240,11 +235,25 @@ class DataLoader:
         out_size = self.batch_size if self.pad_final else n_valid
         imgs = np.empty((out_size,) + self._item_shape, np.uint8)
         labels = np.zeros((out_size,), np.int32)
+        # the shape probe already decoded one sample of this epoch with
+        # its exact rng: reuse it HERE, on the caller thread, so _probe
+        # stays single-writer caller state (the decode spans run on the
+        # pool — guarded-by discipline, dptpu check)
+        skip = ()
+        probe = self._probe
+        if probe is not None and probe[1] == epoch:
+            for j, index in enumerate(batch_indices):
+                if int(index) == probe[0]:
+                    self._probe = None
+                    imgs[j] = probe[2]
+                    labels[j] = probe[3]
+                    skip = (j,)
+                    break
         span = -(-n_valid // self.num_workers)
         futs = [
             self._pool.submit(
                 self._load_span, batch_indices[o:o + span], epoch,
-                imgs, labels, o,
+                imgs, labels, o, skip,
             )
             for o in range(0, n_valid, span)
         ]

@@ -63,6 +63,8 @@ from hashlib import blake2b
 
 import numpy as np
 
+from dptpu.utils.sync import ordered_mp_lock
+
 SEGMENT_PREFIX = "dptpu_cache"
 
 _LIVE_CACHES: "weakref.WeakSet" = weakref.WeakSet()
@@ -213,15 +215,25 @@ class ShmDecodeCache:
         self.max_entries = max_entries
         self.lock_timeout_s = float(lock_timeout_s)
         self._creator = True
-        self._closed = False
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._closed = False  # owned-by: closing-caller
+        # per-process telemetry counters, bumped from every decode
+        # thread OUTSIDE the cross-process locks (the mp stripe locks
+        # guard the SLAB, not this process's counters): a racy += can
+        # only undercount a stat, never corrupt data — the censused
+        # waiver below records that deliberately
+        self.hits = 0  # dptpu: allow-guarded-by(per-process telemetry counter bumped lock-free by design; a torn += only undercounts a stat — the slab itself is guarded by the seqlock commit protocol and the mp stripe locks)
+        self.misses = 0  # dptpu: allow-guarded-by(per-process telemetry counter bumped lock-free by design; a torn += only undercounts a stat — the slab itself is guarded by the seqlock commit protocol and the mp stripe locks)
+        self.evictions = 0  # dptpu: allow-guarded-by(per-process telemetry counter bumped lock-free by design; a torn += only undercounts a stat — the slab itself is guarded by the seqlock commit protocol and the mp stripe locks)
 
         ctx = mp.get_context("spawn")
-        self._alloc_lock = ctx.Lock()
-        self._recovery_lock = ctx.Lock()
-        self._stripe_locks = [ctx.Lock() for _ in range(self.n_stripes)]
+        # the declared arena -> recovery -> stripe order
+        # (dptpu/utils/sync.py LOCK_RANKS; every acquisition in this
+        # protocol is deadline-bounded, so it cannot deadlock — it
+        # times out and degrades to a miss)
+        self._alloc_lock = ordered_mp_lock("shm.alloc", ctx)
+        self._recovery_lock = ordered_mp_lock("shm.recovery", ctx)
+        self._stripe_locks = [ordered_mp_lock("shm.stripe", ctx)
+                              for _ in range(self.n_stripes)]
 
         meta_bytes = (_HDR_LEN + 2 + self.n_stripes
                       + max_entries * _E_LEN + max_entries) * 8
@@ -631,8 +643,12 @@ class ShmDecodeCache:
         if self._closed:
             return
         self._closed = True
-        self._hdr = self._owners = self._entries = None
-        self._fifo = self._arena = None
+        # the mapped views are set once at attach (_map_views, from
+        # __init__/__setstate__) and dropped once here: any worker
+        # racing a close sees either the live views or the _closed
+        # flag's miss-only path
+        self._hdr = self._owners = self._entries = None  # owned-by: closing-caller
+        self._fifo = self._arena = None  # owned-by: closing-caller
         close_segment(self._shm, unlink=self._creator)
         _LIVE_CACHES.discard(self)
 

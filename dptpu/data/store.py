@@ -45,6 +45,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from dptpu.envknob import env_float, env_int, env_str
+from dptpu.utils.sync import OrderedLock
 
 _SCHEMES = ("http://", "https://", "file://")
 
@@ -129,10 +130,12 @@ class Store:
                 f"seconds"
             )
         # telemetry (per-process; the loader aggregates into feed_stats)
-        self.retry_count = 0
-        self.wait_s = 0.0
-        self.bytes_fetched = 0
-        self._lock = threading.Lock()
+        # — fetched from the parent's prefetcher thread AND the
+        # consumer's decode path concurrently
+        self.retry_count = 0  # guarded-by: _lock
+        self.wait_s = 0.0  # guarded-by: _lock
+        self.bytes_fetched = 0  # guarded-by: _lock
+        self._lock = OrderedLock("data.store")
 
     # -- retry engine -------------------------------------------------------
 
@@ -303,7 +306,7 @@ class HTTPStore(Store):
         super().__init__(retries=retries, backoff_s=backoff_s)
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
-        self._range_unsupported = False
+        self._range_unsupported = False  # guarded-by: _lock
 
     def __reduce__(self):
         return (HTTPStore,
@@ -387,7 +390,9 @@ class HTTPStore(Store):
 
     def stats(self) -> dict:
         s = super().stats()
-        if self._range_unsupported:
+        with self._lock:
+            range_unsupported = self._range_unsupported
+        if range_unsupported:
             s["store_range_unsupported"] = True
         return s
 

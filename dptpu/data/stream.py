@@ -78,6 +78,7 @@ from dptpu.data.store import (
     open_store,
 )
 from dptpu.envknob import env_bool, env_choice, env_int
+from dptpu.utils.sync import OrderedLock, StopToken
 
 ALIGN = 4096
 _COALESCE_GAP = 64 << 10  # merge extents closer than this into one read
@@ -112,13 +113,13 @@ class ShardFileReader:
     def __init__(self, path: str, want_odirect: bool = True):
         self.path = path
         self.want_odirect = want_odirect and hasattr(os, "O_DIRECT")
-        self._fd: Optional[int] = None
-        self.odirect = False
-        self.odirect_why = ""
-        self._lock = threading.Lock()
+        self._fd: Optional[int] = None  # guarded-by: _lock
+        self.odirect = False  # guarded-by: _lock
+        self.odirect_why = ""  # guarded-by: _lock
+        self._lock = OrderedLock("data.shard_reader")
         _OPEN_READERS.add(self)
 
-    def _ensure_open(self):
+    def _ensure_open_locked(self):
         if self._fd is not None:
             return
         if self.want_odirect:
@@ -138,7 +139,7 @@ class ShardFileReader:
         self._fd = os.open(self.path, os.O_RDONLY)
         self.odirect = False
 
-    def _fall_back(self, why: str):
+    def _fall_back_locked(self, why: str):
         if self._fd is not None:
             os.close(self._fd)
         self._fd = os.open(self.path, os.O_RDONLY)
@@ -151,7 +152,7 @@ class ShardFileReader:
         O_DIRECT read (into ``buf`` when provided and big enough: the
         prefetcher's double-buffer) or a plain pread."""
         with self._lock:
-            self._ensure_open()
+            self._ensure_open_locked()
             if self.odirect:
                 a0 = (offset // ALIGN) * ALIGN
                 need = -(-(offset + length - a0) // ALIGN) * ALIGN
@@ -169,11 +170,11 @@ class ShardFileReader:
                 except OSError as e:
                     # the open accepted O_DIRECT but the read refused it
                     # (overlayfs quirk): fall back for the file's lifetime
-                    self._fall_back(
+                    self._fall_back_locked(
                         f"O_DIRECT read failed ({e}); plain read() "
                         f"fallback"
                     )
-                    return self._plain_read(offset, length)
+                    return self._plain_read_locked(offset, length)
                 if got < (offset - a0) + length:
                     raise OSError(
                         f"{self.path}: short read — wanted "
@@ -183,9 +184,9 @@ class ShardFileReader:
                     )
                 lo = offset - a0
                 return view[lo:lo + length].tobytes()
-            return self._plain_read(offset, length)
+            return self._plain_read_locked(offset, length)
 
-    def _plain_read(self, offset: int, length: int) -> bytes:
+    def _plain_read_locked(self, offset: int, length: int) -> bytes:
         out = bytearray()
         while len(out) < length:
             chunk = os.pread(self._fd, length - len(out),
@@ -253,25 +254,29 @@ class ShardIOEngine:
         self.fetch_mode = fetch_mode
         self.store = shard_set.store
         self._local = isinstance(self.store, LocalStore)
-        self._readers: dict = {}
-        self._whole_fetched: set = set()
-        self._prefetcher: Optional[_ExtentPrefetcher] = None
-        self._lock = threading.Lock()
+        # the reader table is reached from the prefetcher thread AND
+        # the consumer decode path — creation races would leak an fd
+        self._readers: dict = {}  # guarded-by: _lock
+        self._whole_fetched: set = set()  # owned-by: prefetch-thread
+        self._prefetcher: Optional[_ExtentPrefetcher] = None  # owned-by: caller
+        self._lock = OrderedLock("data.shard_engine")
         # telemetry (this process)
-        self.bytes_read = 0
-        self.extents_read = 0
-        self.cache_hits = 0
-        self.cache_misses = 0
+        self.bytes_read = 0  # guarded-by: _lock
+        self.extents_read = 0  # guarded-by: _lock
+        self.cache_hits = 0  # guarded-by: _lock
+        self.cache_misses = 0  # guarded-by: _lock
 
     # -- byte sources -------------------------------------------------------
 
     def _reader(self, shard_id: int) -> ShardFileReader:
-        r = self._readers.get(shard_id)
-        if r is None:
-            path = self.store.path_for(self.shard_set.shard_names[shard_id])
-            r = ShardFileReader(path, want_odirect=self.odirect_wanted)
-            self._readers[shard_id] = r
-        return r
+        with self._lock:
+            r = self._readers.get(shard_id)
+            if r is None:
+                path = self.store.path_for(
+                    self.shard_set.shard_names[shard_id])
+                r = ShardFileReader(path, want_odirect=self.odirect_wanted)
+                self._readers[shard_id] = r
+            return r
 
     def _fetch_range(self, shard_id: int, offset: int, length: int,
                      buf: Optional[np.ndarray] = None) -> bytes:
@@ -386,7 +391,7 @@ class ShardIOEngine:
         need = max(length for _, length, _m in ranges) + 2 * ALIGN
         bufs = getattr(self, "_ring_bufs", None)
         if bufs is None or bufs[0][1].size < need:
-            bufs = self._ring_bufs = [
+            bufs = self._ring_bufs = [  # owned-by: prefetch-thread
                 _aligned_buffer(need), _aligned_buffer(need),
             ]
         ex = self._range_executor()
@@ -465,7 +470,7 @@ class ShardIOEngine:
                 "shard_cache_hits": self.cache_hits,
                 "shard_cache_misses": self.cache_misses,
             }
-        probe = next(iter(self._readers.values()), None)
+            probe = next(iter(self._readers.values()), None)
         if self._local:
             stats["odirect_active"] = bool(probe and probe.odirect)
             if probe is not None and not probe.odirect:
@@ -485,36 +490,46 @@ class ShardIOEngine:
         if hasattr(self, "_range_pool"):
             self._range_pool.shutdown(wait=True)
             del self._range_pool
-        for r in self._readers.values():
+        with self._lock:
+            readers = list(self._readers.values())
+            self._readers.clear()
+        for r in readers:
             r.close()
-        self._readers.clear()
 
 
 class _ExtentPrefetcher:
     """One background thread draining index batches into
     :meth:`ShardIOEngine._stage_batch`. The queue is SHALLOW and lossy
     (prefetch is advisory — a dropped batch just means the worker's own
-    direct read pays the latency instead)."""
+    direct read pays the latency instead).
+
+    Teardown rides the shared :class:`dptpu.utils.sync.StopToken`
+    idiom: ``close()`` trips the token and nudges the queue with a
+    sentinel, so the drain loop wakes IMMEDIATELY whether it was parked
+    in ``get()`` or mid-stage — and ``close()`` itself never blocks on
+    a full queue (the old ``put(None)`` could)."""
 
     def __init__(self, engine: ShardIOEngine, depth: int = 8):
         self._engine = engine
         self._q: "_queue.Queue" = _queue.Queue(maxsize=depth)
-        self._stop = False
+        self._stop = StopToken()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="dptpu-shard-prefetch"
         )
         self._thread.start()
 
     def enqueue(self, indices: List[int]):
+        if self._stop.stopped:
+            return  # closing: new work would race the teardown
         try:
             self._q.put_nowait(indices)
         except _queue.Full:
             pass  # advisory: the consumer is ahead of the disk already
 
     def _run(self):
-        while True:
+        while not self._stop.stopped:
             item = self._q.get()
-            if item is None:
+            if item is None or self._stop.stopped:
                 return
             try:
                 self._engine._stage_batch(item)
@@ -524,7 +539,13 @@ class _ExtentPrefetcher:
                 pass
 
     def close(self):
-        self._q.put(None)
+        self._stop.stop()
+        try:
+            # wake a get()-parked drain loop; a FULL queue needs no
+            # nudge (the pending item wakes it and the token exits)
+            self._q.put_nowait(None)
+        except _queue.Full:
+            pass
         self._thread.join(timeout=5.0)
 
 

@@ -30,6 +30,8 @@ import threading
 import time
 from typing import List, Optional
 
+from dptpu.utils.sync import OrderedLock
+
 
 class _SpanCM:
     """Context-manager form of a span; ``record()`` is the hot-path API."""
@@ -107,11 +109,14 @@ class Tracer:
         if capacity < 2:
             raise ValueError(f"tracer capacity={capacity} must be >= 2")
         self.capacity = capacity
-        self._buf: list = [None] * capacity
-        self._head = 0  # next write index
-        self._count = 0  # live entries (<= capacity)
-        self.dropped = 0
-        self._lock = threading.Lock()
+        self._buf: list = [None] * capacity  # guarded-by: _lock
+        self._head = 0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
+        # record() is called from EVERY thread (step loop, dispatcher,
+        # prefetcher, writer), often while the caller holds its own
+        # lock: the ring lock is the innermost rank by design
+        self._lock = OrderedLock("obs.trace_ring")
         # anchor: wall = anchor_wall + (t_perf - anchor_perf)
         self.anchor_wall = time.time()
         self.anchor_perf = time.perf_counter()
@@ -129,7 +134,7 @@ class Tracer:
             else:
                 self.dropped += 1
 
-    def _read(self) -> List[tuple]:
+    def _read_locked(self) -> List[tuple]:
         start = (self._head - self._count) % self.capacity
         return [
             self._buf[(start + i) % self.capacity]
@@ -139,13 +144,13 @@ class Tracer:
     def snapshot(self) -> List[dict]:
         """Spans currently in the ring (oldest first), without clearing."""
         with self._lock:
-            recs = self._read()
+            recs = self._read_locked()
         return [self._to_dict(r) for r in recs]
 
     def drain(self) -> List[dict]:
         """Spans since the last drain (oldest first); resets the ring."""
         with self._lock:
-            recs = self._read()
+            recs = self._read_locked()
             self._head = 0
             self._count = 0
         return [self._to_dict(r) for r in recs]

@@ -50,7 +50,12 @@ class ProfileTrigger:
         self.sentinel = sentinel
         self.verbose = verbose
         self.signum = signum
-        self._armed = False  # set by the signal handler / sentinel
+        # set by the SIGUSR2 handler (or arm()/the sentinel on the step
+        # thread) and consumed by tick(): an async-signal flag on
+        # purpose — a lock inside a signal handler could self-deadlock
+        # the main thread it interrupts, and the worst a torn flip can
+        # do is arm one extra capture
+        self._armed = False  # dptpu: allow-guarded-by(async-signal flag: the handler may only SET it and tick consumes it; taking a lock inside a signal handler could self-deadlock the interrupted main thread, and a torn flip at worst arms one extra capture)
         self._active = False
         self._ticks = 0  # steps seen since install (the fallback label)
         self._disabled_reason: Optional[str] = None

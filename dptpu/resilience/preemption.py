@@ -26,9 +26,13 @@ class PreemptionGuard:
 
     def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
         self.signals = tuple(signals)
-        self.requested = False
-        self.signum: Optional[int] = None
-        self._old = {}
+        # async-signal handoff state: the handler (the only writer
+        # after install) sets both; the loop and the quorum tick only
+        # read — single-writer by construction, no lock needed (and a
+        # lock in a signal handler could self-deadlock the main thread)
+        self.requested = False  # owned-by: signal-handler
+        self.signum: Optional[int] = None  # owned-by: signal-handler
+        self._old = {}  # owned-by: caller
 
     def _handler(self, signum, frame):
         if self.requested:

@@ -62,10 +62,12 @@ import json
 import os
 import signal
 import tempfile
+import threading
 import time
 from typing import Dict, Optional
 
 from dptpu.envknob import env_float
+from dptpu.utils.sync import StopToken
 
 
 def quorum_deadline_knob(environ=None) -> float:
@@ -320,6 +322,53 @@ def make_coordinator(num_hosts: int, host_id: int, deadline_s: float,
     return None
 
 
+class QuorumHeartbeat:
+    """Liveness beats from a dedicated thread — the tick source OFF the
+    host thread that ROADMAP item 3 residual (d) called for: a peer
+    parked inside a blocking device fetch keeps beating, so the chief's
+    ``missing_hosts`` verdict distinguishes "slow step" from "gone".
+
+    Teardown rides the shared :class:`dptpu.utils.sync.StopToken`
+    idiom: the loop blocks in ``Event.wait(interval)`` (never a bare
+    ``time.sleep`` + flag poll), so ``close()`` wakes it immediately
+    and joins promptly — the conftest thread census never sees a
+    lingering beat thread.
+    """
+
+    def __init__(self, coordinator: QuorumCoordinator, step_fn,
+                 interval_s: float = 1.0):
+        if interval_s <= 0:
+            raise ValueError(
+                f"heartbeat interval_s={interval_s} must be > 0 seconds"
+            )
+        self.coord = coordinator
+        self.interval_s = float(interval_s)
+        self._step_fn = step_fn
+        self._stop = StopToken()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dptpu-quorum-heartbeat"
+        )
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.coord.heartbeat(int(self._step_fn()))
+            except Exception:
+                # liveness is best-effort by design: a flaky KV write
+                # must never kill the beat loop (a missing beat ages
+                # out; a dead beat thread looks like a dead host)
+                pass
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self):
+        self._stop.stop()
+        self._thread.join(timeout=5.0)
+
+
 class QuorumSession:
     """Per-``fit()`` driver of the protocol: one ``tick()`` per
     completed optimizer step (riding the same post-step hook as fault
@@ -350,9 +399,34 @@ class QuorumSession:
         self._reason = ""
         # heartbeats are throttled: liveness needs ~1 Hz, not one KV
         # write per optimizer step (the store may be the pod's real
-        # coordination service)
+        # coordination service). start_heartbeat() moves them onto a
+        # dedicated QuorumHeartbeat thread; the inline tick beats are
+        # the fallback when no thread was started (unit tests driving
+        # tick() directly keep their behavior).
         self._beat_every_s = 1.0
         self._last_beat = 0.0
+        self._hb: Optional[QuorumHeartbeat] = None
+
+    # -- off-thread liveness ------------------------------------------------
+
+    def start_heartbeat(self, interval_s: float = 1.0) -> QuorumHeartbeat:
+        """Move liveness beats onto a dedicated thread (fit() does this
+        right after arming the session). Idempotent."""
+        if self._hb is None:
+            # reading self.step from the beat thread is a single int
+            # load of caller-owned state: atomic under the GIL, and a
+            # one-step-stale beat is indistinguishable from a beat that
+            # raced the step boundary
+            self._hb = QuorumHeartbeat(
+                self.coord, lambda: self.step, interval_s
+            )
+        return self._hb
+
+    def close(self):
+        """Stop the heartbeat thread (prompt — StopToken teardown)."""
+        if self._hb is not None:
+            self._hb.close()
+            self._hb = None
 
     # -- position ----------------------------------------------------------
 
@@ -365,10 +439,11 @@ class QuorumSession:
     def tick(self):
         """Called once after every completed optimizer step."""
         self.step += 1
-        now = time.monotonic()
-        if now - self._last_beat >= self._beat_every_s:
-            self.coord.heartbeat(self.step)
-            self._last_beat = now
+        if self._hb is None:
+            now = time.monotonic()
+            if now - self._last_beat >= self._beat_every_s:
+                self.coord.heartbeat(self.step)
+                self._last_beat = now
         if self._stop:
             return
         if self.guard is not None and self.guard.requested \

@@ -32,6 +32,7 @@ from dptpu import obs
 from dptpu.data.transforms import ValTransform
 from dptpu.serve.preprocess import preprocess_bytes, val_resize_for
 from dptpu.serve.staging import StagingRing
+from dptpu.utils.sync import OrderedLock
 
 
 class ServeError(RuntimeError):
@@ -46,10 +47,13 @@ class ServeFuture:
 
     def __init__(self):
         self._event = threading.Event()
-        self._logits = None
-        self._error = None
-        self.generation = None  # weight generation that served it
-        self.timings: Dict[str, float] = {}
+        self._logits = None  # owned-by: dispatcher
+        self._error = None  # owned-by: dispatcher
+        self.generation = None  # owned-by: dispatcher
+        self.timings: Dict[str, float] = {}  # owned-by: dispatcher
+        # all four are written once by the fulfilling thread BEFORE
+        # _event.set() and read only after _event.wait() returns — the
+        # Event is the publication barrier (single-writer handoff)
 
     def _fulfill(self, logits, generation, timings):
         self._logits = logits
@@ -107,23 +111,23 @@ class DynamicBatcher:
         self._tf = ValTransform(
             engine.image_size, val_resize_for(engine.image_size)
         )
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.batcher")
         self._cond = threading.Condition(self._lock)
-        self._open: Optional[int] = None  # slot being filled
-        self._open_reqs: list = []
-        self._closing = False
-        # telemetry (guarded by _lock)
-        self._completed = 0
-        self._failed = 0
-        self._batches = 0
-        self._batch_seq = 0  # dispatch order tag (futures' batch_index)
-        self._bucket_counts: Dict[int, int] = {}
-        self._occupancy_sum = 0.0
-        self._pad_rows = 0
-        self._exec_rows = 0
+        self._open: Optional[int] = None  # guarded-by: _lock
+        self._open_reqs: list = []  # guarded-by: _lock
+        self._closing = False  # guarded-by: _lock
+        # telemetry
+        self._completed = 0  # guarded-by: _lock
+        self._failed = 0  # guarded-by: _lock
+        self._batches = 0  # guarded-by: _lock
+        self._batch_seq = 0  # guarded-by: _lock
+        self._bucket_counts: Dict[int, int] = {}  # guarded-by: _lock
+        self._occupancy_sum = 0.0  # guarded-by: _lock
+        self._pad_rows = 0  # guarded-by: _lock
+        self._exec_rows = 0  # guarded-by: _lock
         self._latency = obs.get_registry().histogram("Serve/latency_ms")
-        self._qps_t0 = time.perf_counter()
-        self._qps_n0 = 0
+        self._qps_t0 = time.perf_counter()  # guarded-by: _lock
+        self._qps_n0 = 0  # guarded-by: _lock
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dptpu-serve-dispatch",
             daemon=True,

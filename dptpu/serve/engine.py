@@ -56,7 +56,6 @@ Design (ISSUE 7 tentpole):
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -66,6 +65,7 @@ import jax.numpy as jnp
 
 from dptpu import obs
 from dptpu.serve.knobs import parse_buckets
+from dptpu.utils.sync import OrderedLock
 
 # the measured gemv/gemm divergence floor (module docstring): every
 # executable's leading dim is >= 2 so all buckets share one lowering
@@ -182,10 +182,10 @@ class ServeEngine:
 
         # generation store: {gen: device-placed variables}; a dispatched
         # batch pins its generation until its logits materialize
-        self._lock = threading.Lock()
-        self._gen = 1
-        self._weights: Dict[int, dict] = {1: self._place(variables)}
-        self._inflight: Dict[int, int] = {1: 0}
+        self._lock = OrderedLock("serve.engine")
+        self._gen = 1  # guarded-by: _lock
+        self._weights: Dict[int, dict] = {1: self._place(variables)}  # guarded-by: _lock
+        self._inflight: Dict[int, int] = {1: 0}  # guarded-by: _lock
 
         # AOT compile the ladder (dedup buckets that share an exec size:
         # 1 and 2 both execute at the floor)
@@ -302,7 +302,8 @@ class ServeEngine:
 
     @property
     def current_generation(self) -> int:
-        return self._gen
+        with self._lock:
+            return self._gen
 
     # -- execution ------------------------------------------------------
 

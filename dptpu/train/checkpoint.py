@@ -35,6 +35,7 @@ from flax import serialization
 
 from dptpu.models.pretrained import QKV_LAYOUT, qkv_needs_migration
 from dptpu.train.state import map_momentum
+from dptpu.utils.sync import OrderedLock
 
 CHECKPOINT_NAME = "checkpoint.pth.tar"
 BEST_NAME = "model_best.pth.tar"
@@ -108,7 +109,8 @@ class AsyncCheckpointWriter:
 
     def __init__(self, max_pending: int = 2):
         self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
-        self._exc: Optional[BaseException] = None
+        self._lock = OrderedLock("train.ckpt_writer")
+        self._exc: Optional[BaseException] = None  # guarded-by: _lock
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="dptpu-ckpt-writer"
         )
@@ -122,12 +124,14 @@ class AsyncCheckpointWriter:
                     return
                 fn()
             except BaseException as e:  # surfaced on the next call-in
-                self._exc = e
+                with self._lock:
+                    self._exc = e
             finally:
                 self._q.task_done()
 
     def _raise_pending(self):
-        exc, self._exc = self._exc, None
+        with self._lock:
+            exc, self._exc = self._exc, None
         if exc is not None:
             raise RuntimeError(
                 "async checkpoint write failed (surfacing on the next "

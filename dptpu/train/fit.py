@@ -1571,6 +1571,14 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     current_pos = {"epoch": start_epoch, "step": resume_step}
     emergency = {"saved": False}
     try:
+      # liveness beats ride a dedicated thread (StopToken teardown), so
+      # a host parked inside a blocking device fetch keeps beating and
+      # the chief's missing_hosts verdict stays meaningful (ROADMAP
+      # item 3 residual (d)). Started INSIDE the try: every exit path
+      # — including a setup failure below — reaches the finally that
+      # closes it, so a dead host can never keep beating.
+      if qs is not None:
+          qs.start_heartbeat()
       with guard:
         for epoch in range(start_epoch, cfg.epochs):
             if batch_ramp is not None \
@@ -1891,6 +1899,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # must fail the run, not vanish).
         propagating = sys.exc_info()[0] is not None
         teardown_errors = []
+        if qs is not None:
+            # stop the heartbeat thread on EVERY exit path: a dead
+            # host whose beat thread keeps posting would mask the
+            # chief's missing_hosts verdict — the exact signal the
+            # off-thread heartbeat exists to make meaningful
+            try:
+                qs.close()
+            except Exception as e:
+                teardown_errors.append(e)
         if trigger is not None:
             try:
                 trigger.uninstall()

@@ -1,0 +1,351 @@
+"""Runtime half of the concurrency contract: ordered locks + stop tokens.
+
+The static half (``dptpu check``'s ``guarded-by`` / ``lock-order`` /
+``thread-hygiene`` rules, dptpu/analysis/concurrency.py) derives a
+repo-wide lock acquisition order from the AST; this module is where that
+order is DECLARED (:data:`LOCK_RANKS`) and asserted at runtime.
+
+* :func:`OrderedLock` / :func:`OrderedRLock` / :func:`ordered_mp_lock` —
+  factories for the repo's named locks. ZERO-COST unless
+  ``DPTPU_SYNC_CHECK=1``: disabled they return the raw primitive
+  (``threading.Lock()`` etc.) with no wrapping at all, so production hot
+  paths pay nothing. Enabled, every lock records per-thread acquisition
+  stacks and an UNBOUNDED acquire while already holding a lock of equal
+  or higher rank raises :class:`LockOrderError` naming both locks and
+  both acquisition stacks — the ABBA deadlock surfaces as a loud,
+  attributable failure on the FIRST inverted acquisition, not as a
+  wedged pod an hour later. Deadline-bounded acquisitions
+  (``timeout=``/``blocking=False`` — the shm slab's whole protocol) are
+  exempt from the order assert: a bounded try-acquire cannot deadlock,
+  it can only time out.
+
+* :func:`held_locks` — the per-thread held-lock registry the
+  ``# guarded-by:`` annotations conceptually name; a debugging aid and
+  the sanitizer's own bookkeeping.
+
+* :class:`StopToken` — the one blessed thread-teardown idiom: loops
+  block in ``token.wait(interval)`` instead of ``time.sleep`` +
+  flag-polling, so ``stop()`` wakes them IMMEDIATELY and teardown is
+  prompt (the quorum heartbeat and the shard-extent prefetcher ride it;
+  the ``thread-hygiene`` lint polices new threads toward it).
+
+Stdlib-only — imported by the data layer (spawned decode workers, never
+JAX) and by the lint rules themselves (which cross-check every
+``OrderedLock("name")`` literal against :data:`LOCK_RANKS`).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import List, Optional, Tuple
+
+from dptpu.envknob import env_bool
+
+SYNC_CHECK_KNOB = "DPTPU_SYNC_CHECK"
+
+
+def sync_check_enabled(environ=None) -> bool:
+    """The ``DPTPU_SYNC_CHECK`` knob under the locked fail-fast
+    contract. Read at LOCK CONSTRUCTION time (not per acquire), so the
+    disabled mode's zero-wrapping guarantee holds."""
+    return bool(env_bool(SYNC_CHECK_KNOB, False, environ))
+
+
+# The global lock order, low rank = acquired first (outermost). A thread
+# may only take an UNBOUNDED acquisition of a lock whose rank is
+# STRICTLY greater than every lock it already holds. Derived from the
+# static lock-order graph (``dptpu check``) and documented with the
+# thread inventory in CONCURRENCY.md; the lock-order lint rejects an
+# ``OrderedLock(name)`` whose name is not declared here, and rejects
+# nested ``with`` scopes that invert these ranks.
+LOCK_RANKS = {
+    # serve: the batcher's dispatcher/submitter seam is outermost (it
+    # calls into the engine, the histogram and the tracer while running)
+    "serve.batcher": 10,
+    "serve.engine": 20,
+    # train: the async checkpoint writer's error seam
+    "train.ckpt_writer": 30,
+    # data plane: store telemetry > shard engine > per-file reader >
+    # in-process decode cache
+    "data.store": 40,
+    "data.shard_engine": 50,
+    "data.shard_reader": 60,
+    "data.decode_cache": 70,
+    # observability: the trace ring is innermost — record() may be
+    # called from any thread, under anyone's lock
+    "obs.trace_ring": 80,
+    # cross-process pooled slab (dptpu/data/shm_cache.py). Every
+    # acquisition in that protocol is deadline-bounded (try-acquire +
+    # orphan recovery), so the runtime order assert never applies; the
+    # ranks document the designed arena -> recovery -> stripe order.
+    "shm.alloc": 100,
+    "shm.recovery": 110,
+    "shm.stripe": 120,
+}
+
+
+class LockOrderError(RuntimeError):
+    """An unbounded acquisition inverted the declared LOCK_RANKS order."""
+
+
+# -- per-thread held-lock registry -------------------------------------------
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "held", None)
+    if s is None:
+        s = _tls.held = []
+    return s
+
+
+def held_locks() -> List[Tuple[str, int]]:
+    """``[(name, rank), ...]`` of checked locks THIS thread holds,
+    oldest first. Empty when ``DPTPU_SYNC_CHECK`` is off (raw locks do
+    no bookkeeping — that is the zero-cost contract)."""
+    return [(e[1], e[2]) for e in _stack()]
+
+
+def _capture_frames(skip: int = 2, limit: int = 12) -> List[str]:
+    """A cheap acquisition stack: ``file:line in func`` frames walked via
+    sys._getframe — no linecache I/O, ~µs, affordable per acquire."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return []
+    out: List[str] = []
+    while f is not None and len(out) < limit:
+        out.append(
+            f"{f.f_code.co_filename}:{f.f_lineno} in {f.f_code.co_name}"
+        )
+        f = f.f_back
+    return out
+
+
+def _check_order(lock, name: str, rank: int, reentrant: bool):
+    """The order assert for an UNBOUNDED acquire: every lock this thread
+    already holds must rank strictly below the one being taken."""
+    for entry in _stack():
+        held_obj, held_name, held_rank, held_frames = entry
+        if held_obj is lock:
+            if reentrant:
+                continue  # RLock re-entry is legal by definition
+            raise LockOrderError(
+                f"dptpu sync: re-acquiring non-reentrant lock "
+                f"'{name}' already held by this thread (self-deadlock)."
+                f"\n  first acquired at:\n    "
+                + "\n    ".join(held_frames)
+                + "\n  re-acquired at:\n    "
+                + "\n    ".join(_capture_frames(skip=3))
+            )
+        if held_rank >= rank:
+            raise LockOrderError(
+                f"dptpu sync: lock order violation — acquiring "
+                f"'{name}' (rank {rank}) while holding "
+                f"'{held_name}' (rank {held_rank}); the declared order "
+                f"(dptpu/utils/sync.py LOCK_RANKS, CONCURRENCY.md) "
+                f"requires '{name}' first."
+                f"\n  '{held_name}' acquired at:\n    "
+                + "\n    ".join(held_frames)
+                + f"\n  '{name}' acquisition at:\n    "
+                + "\n    ".join(_capture_frames(skip=3))
+            )
+
+
+def _push(lock, name: str, rank: int):
+    _stack().append((lock, name, rank, _capture_frames(skip=3)))
+
+
+def _pop(lock):
+    s = _stack()
+    # search from the top: releases are LIFO in practice, and a release
+    # of a lock this thread never recorded (the shm orphan-recovery
+    # path releasing a DEAD owner's semaphore) must stay a no-op here
+    for i in range(len(s) - 1, -1, -1):
+        if s[i][0] is lock:
+            del s[i]
+            return
+
+
+class _CheckedLock:
+    """threading.Lock with rank checking + held bookkeeping (the
+    DPTPU_SYNC_CHECK=1 arm; disabled mode never builds one)."""
+
+    _reentrant = False
+
+    def __init__(self, name: str, rank: int, inner=None):
+        self.name = name
+        self.rank = rank
+        self._inner = inner if inner is not None else self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        bounded = (not blocking) or (timeout is not None and timeout >= 0)
+        if not bounded:
+            _check_order(self, self.name, self.rank, self._reentrant)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _push(self, self.name, self.rank)
+        return got
+
+    def release(self):
+        _pop(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _is_owned(self) -> bool:
+        # accurate ownership for threading.Condition (the raw Lock
+        # fallback probe would call acquire(False) and say "owned"
+        # whenever ANYONE holds it)
+        return any(e[0] is self for e in _stack())
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.name!r} rank={self.rank} "
+                f"inner={self._inner!r}>")
+
+
+class _CheckedRLock(_CheckedLock):
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+class _CheckedMpLock:
+    """A ``multiprocessing`` Lock under the same bookkeeping. The shm
+    slab acquires ONLY with deadlines (bounded — no order assert ever
+    fires), so this wrapper's value is the held registry and the shared
+    naming. Pickles across the spawn boundary exactly like the raw mp
+    lock it wraps (the attach spec in ShmDecodeCache.__getstate__)."""
+
+    def __init__(self, inner, name: str, rank: int):
+        self._inner = inner
+        self.name = name
+        self.rank = rank
+
+    def acquire(self, block: bool = True, timeout: Optional[float] = None
+                ) -> bool:
+        if block and timeout is None:
+            _check_order(self, self.name, self.rank, reentrant=False)
+        got = self._inner.acquire(block, timeout)
+        if got:
+            _push(self, self.name, self.rank)
+        return got
+
+    def release(self):
+        _pop(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getstate__(self):
+        # rides the same spawn-only boundary as the raw mp lock
+        return {"inner": self._inner, "name": self.name, "rank": self.rank}
+
+    def __setstate__(self, state):
+        self._inner = state["inner"]
+        self.name = state["name"]
+        self.rank = state["rank"]
+
+
+def _resolve_rank(name: str) -> int:
+    if name not in LOCK_RANKS:
+        raise ValueError(
+            f"OrderedLock name {name!r} is not declared in "
+            f"dptpu/utils/sync.py LOCK_RANKS — declare it (and its place "
+            f"in the CONCURRENCY.md order table); known: "
+            f"{', '.join(sorted(LOCK_RANKS))}"
+        )
+    return LOCK_RANKS[name]
+
+
+def OrderedLock(name: str):
+    """A named, rank-ordered mutex. ``DPTPU_SYNC_CHECK`` off (the
+    default): returns a RAW ``threading.Lock`` — zero wrapping, zero
+    cost. On: a checked lock that asserts :data:`LOCK_RANKS` on every
+    unbounded acquire. The name must be declared in LOCK_RANKS (the
+    lock-order lint enforces this statically too)."""
+    rank = _resolve_rank(name)
+    if not sync_check_enabled():
+        return threading.Lock()
+    return _CheckedLock(name, rank)
+
+
+def OrderedRLock(name: str):
+    """Reentrant variant of :func:`OrderedLock` (same-lock re-entry is
+    exempt from the order assert)."""
+    rank = _resolve_rank(name)
+    if not sync_check_enabled():
+        return threading.RLock()
+    return _CheckedRLock(name, rank)
+
+
+def ordered_mp_lock(name: str, ctx):
+    """A ``multiprocessing`` lock (from ``ctx``) under the shared naming/
+    bookkeeping; raw ``ctx.Lock()`` when the check is off."""
+    rank = _resolve_rank(name)
+    inner = ctx.Lock()
+    if not sync_check_enabled():
+        return inner
+    return _CheckedMpLock(inner, name, rank)
+
+
+# -- the stop-token teardown idiom -------------------------------------------
+
+
+class StopToken:
+    """The one blessed way a dptpu background thread idles and stops.
+
+    A loop that would otherwise ``time.sleep(interval)`` and poll a
+    ``self._stop`` flag blocks in ``token.wait(interval)`` instead:
+    ``stop()`` sets the underlying Event and the waiter wakes
+    IMMEDIATELY — teardown latency is the cost of the in-flight work
+    item, never the residue of a sleep. The canonical loop::
+
+        while not stop.wait(interval_s):
+            do_periodic_work()        # heartbeat, poll, flush...
+
+    and for queue-draining threads, pair ``stop()`` with a sentinel
+    enqueue so a blocking ``get()`` wakes too.
+    """
+
+    __slots__ = ("_event",)
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def stop(self):
+        self._event.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block up to ``timeout`` (None = forever); True when stopped."""
+        return self._event.wait(timeout)

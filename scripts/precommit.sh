@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Seconds-fast pre-commit gate (ISSUE 14 satellite): the lint half of
+# `dptpu check` over ONLY the files changed vs git, then the tier-1
+# fast marker tier. Wire it up with:
+#
+#   ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
+#
+# The full gate (HLO budgets + the whole suite) stays in CI / tier-1;
+# this hook exists so a knob-contract typo, an unannotated shared
+# attribute, or an inverted lock acquisition never even reaches a
+# commit. Skip the test tier with PRECOMMIT_LINT_ONLY=1 when iterating
+# (deliberately NOT a DPTPU_* name: the dptpu knob registry/README
+# contract covers runtime knobs the python code reads, and this is a
+# hook-local shell switch).
+set -euo pipefail
+
+# resolve through the .git/hooks symlink: $0 is .git/hooks/pre-commit
+# when installed, and dirname of THAT would land the check inside .git/
+cd "$(dirname "$(readlink -f "$0")")/.."
+
+echo "=> dptpu check --no-hlo --changed-only"
+python -m dptpu.analysis --no-hlo --changed-only
+
+if [ "${PRECOMMIT_LINT_ONLY:-0}" != "1" ]; then
+    # the fast tier: unit tests with no model compiles (~1-2 min); the
+    # conftest arms DPTPU_SYNC_CHECK=1 + the thread census, so the
+    # lock-order sanitizer runs here too
+    echo "=> pytest -m fast"
+    python -m pytest tests/ -q -m fast -p no:cacheprovider
+fi

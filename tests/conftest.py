@@ -19,6 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Arm the lock-order sanitizer for the WHOLE suite (ISSUE 14): every
+# OrderedLock built during tests records per-thread acquisition stacks
+# and asserts the declared LOCK_RANKS order, so tier-1 exercises the
+# real lock orders under load — an inverted acquisition fails the test
+# that performed it, with both stacks in the message. Must be set
+# BEFORE any dptpu module constructs a lock (the knob is read at lock
+# construction, which is what keeps the disabled mode zero-cost).
+os.environ.setdefault("DPTPU_SYNC_CHECK", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -79,6 +88,11 @@ _FAST_MODULES = {
     # (the test_hierarchy precedent, cached module-wide) — the
     # zero-findings + HLO-budget acceptance bars MUST hold in tier 1
     "test_analysis", "test_analysis_repo",
+    # concurrency analyzer (ISSUE 14): the three lint rules are pure
+    # stdlib; the runtime OrderedLock/StopToken/heartbeat units are
+    # sub-second thread exercises — the ABBA and unguarded-shared-write
+    # acceptance bars MUST hold in tier 1
+    "test_concurrency",
     # overlapped gradient comms (ISSUE 13): partitioner/evidence units
     # are pure; the parity ladder compiles TinyDense-sized shard_map
     # steps (the test_hierarchy precedent) and holds the acceptance
@@ -191,6 +205,53 @@ def dptpu_shm_leak_guard():
     assert _obs_report.live_merge_tmp_count() == merge_tmps_before, (
         "pod-timeline merge temp files leaked: a merge_pod_timeline "
         "call neither completed its atomic rename nor unlinked its temp"
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def dptpu_thread_census():
+    """CI gate on thread hygiene (the shm-segment/fd/lease censuses'
+    sibling, ISSUE 14): every ``dptpu``-named thread started during the
+    suite must be stopped by session end. A leaked NON-daemon thread
+    blocks interpreter exit in production; a leaked daemon thread —
+    and all of dptpu's service threads are daemon by design — keeps
+    touching shared state (posting heartbeats for a dead host,
+    dispatching against a closed ring) long after its owner died, so
+    daemons are policed too, with a short join grace for pools mid-
+    ``shutdown(wait=False)``. The census names the thread and its
+    target so the leak is attributable; the static half (``dptpu
+    check``'s thread-hygiene rule) enforces the dptpu- name prefix it
+    keys on."""
+    import threading
+
+    def census():
+        return [
+            t for t in threading.enumerate()
+            if t is not threading.main_thread() and t.is_alive()
+            and t.name.startswith("dptpu")
+        ]
+
+    before = {id(t) for t in census()}
+    yield
+    import gc
+
+    gc.collect()  # run __del__ teardown for dropped owners first
+    leaked = []
+    for t in census():
+        if id(t) in before:
+            continue
+        t.join(timeout=2.0)  # grace for executor shutdown(wait=False)
+        if t.is_alive():
+            leaked.append(t)
+    assert not leaked, (
+        "leaked dptpu threads alive at session end (started during "
+        "the suite, never stopped/joined): "
+        + ", ".join(
+            f"{t.name}"
+            f" ({'daemon' if t.daemon else 'NON-DAEMON'},"
+            f" target={getattr(getattr(t, '_target', None), '__qualname__', getattr(t, '_target', None))!r})"
+            for t in leaked
+        )
     )
 
 
