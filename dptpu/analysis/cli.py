@@ -25,8 +25,8 @@ def build_check_parser() -> argparse.ArgumentParser:
         prog="dptpu check",
         description="repo-invariant static analysis: AST lints "
                     "(knob-contract / determinism / host-sync / "
-                    "shm-hygiene / shard-map) + HLO budget gates "
-                    "(dptpu/analysis)",
+                    "shm-hygiene / shard-map) + HLO budget gates + "
+                    "partition-rules table checks (dptpu/analysis)",
     )
     p.add_argument("--root", default=".", metavar="DIR",
                    help="repo root to check (default: .)")
@@ -195,6 +195,8 @@ def main_check(argv=None) -> int:
         print(line)
     for line in report.get("hlo", {}).get("violations", ()):
         print(line)
+    for line in report.get("partition_rules", {}).get("violations", ()):
+        print(line)
     if args.json:
         write_report(report, args.json)
     if not args.quiet:
@@ -204,11 +206,16 @@ def main_check(argv=None) -> int:
             "skipped" if hlo["ok"] is None
             else ("ok" if hlo["ok"] else "FAILED")
         )
+        rules = report["partition_rules"]
+        rules_note = (
+            "skipped" if rules["ok"] is None
+            else ("ok" if rules["ok"] else "FAILED")
+        )
         print(
             f"=> dptpu check: {lint['files_scanned']} files, "
             f"{len(lint['findings'])} finding(s), "
             f"{len(lint['suppressions'])} reasoned suppression(s), "
-            f"HLO budgets {hlo_note} — "
+            f"HLO budgets {hlo_note}, partition rules {rules_note} — "
             f"{'clean' if report['ok'] else 'NOT CLEAN'}"
         )
     return 0 if report["ok"] else 1

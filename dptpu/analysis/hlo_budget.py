@@ -19,7 +19,13 @@ committed budget table ``HLO_BUDGETS.json``:
   engine (``DPTPU_OVERLAP=1``, dptpu/parallel/overlap.py) emits >= 2
   independent per-bucket reductions INTERLEAVED with backward compute
   in the compiled schedule (``hlo_accounting.overlap_evidence``), at
-  total collective bytes within 0.1% of the unbucketed program.
+  total collective bytes within 0.1% of the unbucketed program;
+* the rules-engine configs (ISSUE 16): ``zero3`` reproduces the DDP
+  collective volume as AG+RS+AR (the r06 equivalence, stage-3 form),
+  ``gspmd_hier`` keeps DCN bytes under half of flat GSPMD's all-DCN
+  volume on the ``{slice, data}``-factored mesh, and ``gspmd_overlap``
+  holds the partitioner's reduction volume at the DDP analytic with
+  the same interleaving evidence as the shard_map overlap configs.
 
 A comms/sharding regression therefore fails ``dptpu check`` BEFORE any
 bench runs. After an INTENDED change, re-commit the table with
@@ -44,7 +50,8 @@ _N = 4
 _SLICES = 2
 
 REPRESENTATIVE_CONFIGS = ("ddp", "zero1", "accum", "slices",
-                          "ddp_overlap", "zero1_overlap", "slices_overlap")
+                          "ddp_overlap", "zero1_overlap", "slices_overlap",
+                          "zero3", "gspmd_hier", "gspmd_overlap")
 
 # bucket bound for the overlap configs: small enough that the probe
 # model's ~7 KB of gradients split into >= 2 buckets (the evidence
@@ -148,9 +155,12 @@ def _compile_config(name: str) -> Tuple[str, dict]:
         make_hierarchical_mesh,
         make_mesh,
         make_zero1_train_step,
+        make_zero3_train_step,
         replicated_sharding,
         shard_host_batch,
         shard_zero1_state,
+        shard_zero3_state,
+        zero3_param_specs,
     )
     from dptpu.train import make_train_step
 
@@ -187,12 +197,47 @@ def _compile_config(name: str) -> Tuple[str, dict]:
         mesh = make_mesh(devices, {"data": _N})
         step = make_train_step(mesh, overlap=True,
                                bucket_bytes=_OVERLAP_BUCKET_BYTES)
+    elif name == "zero3":
+        # ZeRO-3/FSDP: rules-table placement over the data axis; the
+        # probe model is not a registry family, so the GENERIC table's
+        # AUTO_FSDP row drives it (same as any CNN)
+        mesh = make_mesh(devices, {"data": _N})
+        z3_specs = zero3_param_specs("budgetnet", st.params, mesh)
+        step = make_zero3_train_step(mesh, st, z3_specs)
+    elif name in ("gspmd_hier", "gspmd_overlap"):
+        from dptpu.parallel.gspmd import (
+            dp_specs,
+            gspmd_specs_for_arch,
+            make_gspmd_train_step,
+            shard_gspmd_state,
+        )
+
+        if name == "gspmd_hier":
+            # the {slice, data}-factored mesh + rules-table FSDP
+            # placement: the partitioner derives the DCN-aware
+            # decomposition itself (the by_link gate below)
+            mesh = make_hierarchical_mesh(_SLICES, devices)
+            specs = gspmd_specs_for_arch("budgetnet", st.params, mesh,
+                                         fsdp=True)
+            step = make_gspmd_train_step(mesh, st, specs)
+        else:
+            mesh = make_mesh(devices, {"data": _N})
+            specs = dp_specs(st.params)
+            step = make_gspmd_train_step(
+                mesh, st, specs, overlap=True,
+                bucket_bytes=_OVERLAP_BUCKET_BYTES,
+            )
+        st = shard_gspmd_state(st, mesh, specs)
+        batch = shard_host_batch(_batch(), mesh)
+        return step.lower(st, batch).compile().as_text(), facts
     else:
         raise ValueError(
             f"unknown budget config {name!r} "
             f"(representative set: {', '.join(REPRESENTATIVE_CONFIGS)})"
         )
-    if name.startswith("zero1"):
+    if name == "zero3":
+        st = shard_zero3_state(st, mesh, z3_specs)
+    elif name.startswith("zero1"):
         st = shard_zero1_state(st, mesh)
     else:
         st = jax.tree_util.tree_map(
@@ -224,7 +269,7 @@ def extract_budget(name: str) -> Tuple[dict, dict]:
         "alias_entries": donated_alias_count(txt),
         "f64_shapes": op_census(txt)["f64_shapes"],
     }
-    if name in ("slices", "slices_overlap"):
+    if name in ("slices", "slices_overlap", "gspmd_hier"):
         row["by_link"] = collective_bytes_by_link(
             txt, lambda p: p // inner, _N
         )
@@ -309,6 +354,26 @@ def _analytic_violations(computed: dict) -> List[BudgetViolation]:
             f"{z} bytes vs DDP's {ddp['total']} — ZeRO-1's AG+RS volume "
             f"must equal the DDP all-reduce (the r06 equivalence)",
         ))
+    # ZeRO-3: gather-on-use + scatter-on-grad is the SAME volume as the
+    # DDP all-reduce (AG (n-1)/n·G forward + RS (n-1)/n·G backward +
+    # the pmean AR — the r06 equivalence extended to stage 3), and the
+    # program must actually show the gather/scatter shape
+    z3 = cfg["zero3"]["per_chip"]
+    if not (z3["all-gather"] > 0 and z3["reduce-scatter"] > 0):
+        out.append(BudgetViolation(
+            "zero3", "per_chip",
+            f"AG={z3['all-gather']} RS={z3['reduce-scatter']} bytes — "
+            f"ZeRO-3 must all-gather params at use and reduce-scatter "
+            f"the grads (did the placement collapse to replicated?)",
+        ))
+    if not (ddp["total"] > 0
+            and abs(z3["total"] - ddp["total"]) / ddp["total"] < 0.001):
+        out.append(BudgetViolation(
+            "zero3", "per_chip.total",
+            f"{z3['total']} bytes vs DDP's {ddp['total']} — ZeRO-3's "
+            f"AG+RS+AR volume must equal the DDP all-reduce (the r06 "
+            f"equivalence, stage-3 form)",
+        ))
     if (cfg["accum"]["collective_instructions"]
             != cfg["ddp"]["collective_instructions"]):
         out.append(BudgetViolation(
@@ -361,7 +426,48 @@ def _analytic_violations(computed: dict) -> List[BudgetViolation]:
                 f"— bucketing must be a pure regrouping of the same "
                 f"reduction bytes (0.1% gate)",
             ))
-    for cname in ("ddp_overlap", "zero1_overlap", "slices_overlap"):
+    # GSPMD gates. The partitioner derives its own collectives, so the
+    # honest assertions differ from the shard_map ones:
+    # * gspmd_overlap — the bucket boundaries are sharding-constraint
+    #   annotations on logically-pre-reduced grads; the partitioner's
+    #   per-leaf reductions ALREADY interleave with backward compute,
+    #   and bucketing must stay a pure regrouping of the same volume
+    #   (in practice the compiled program is identical to unbucketed —
+    #   the gate is that the volume matches the DDP analytic, plus the
+    #   overlap evidence thresholds in the *_overlap loop below).
+    go = cfg["gspmd_overlap"]["per_chip"]
+    if not close(go["all-reduce"], want):
+        out.append(BudgetViolation(
+            "gspmd_overlap", "per_chip.all-reduce",
+            f"{go['all-reduce']} bytes vs the DDP analytic "
+            f"2(n-1)/n·(G+P) = {want:.0f} — the partitioner's gradient "
+            f"reduction volume drifted",
+        ))
+    # * gspmd_hier — the partitioner picks its own decomposition (AG+AR
+    #   mixes, not the shard_map RS/AR/AG ladder), so the gate is the
+    #   CLAIM that matters: the {slice, data} factoring + FSDP placement
+    #   moves traffic off DCN. Flat GSPMD on this topology map crosses
+    #   its whole volume over DCN (every group spans the world), so
+    #   hier DCN bytes must stay under half of that, with ICI carrying
+    #   the majority.
+    gh = cfg["gspmd_hier"]["by_link"]
+    flat_total = cfg["gspmd_overlap"]["per_chip"]["total"]
+    if not (gh["dcn"]["total"] * 2 < flat_total):
+        out.append(BudgetViolation(
+            "gspmd_hier", "by_link.dcn.total",
+            f"{gh['dcn']['total']} DCN bytes vs flat GSPMD's "
+            f"{flat_total} all-DCN bytes — the hierarchical mesh no "
+            f"longer moves the reduction off the slow link",
+        ))
+    if not (gh["ici"]["total"] > gh["dcn"]["total"]):
+        out.append(BudgetViolation(
+            "gspmd_hier", "by_link",
+            f"ici={gh['ici']['total']} <= dcn={gh['dcn']['total']} "
+            f"bytes — ICI must carry the majority of the collective "
+            f"traffic on a {_SLICES}-slice mesh",
+        ))
+    for cname in ("ddp_overlap", "zero1_overlap", "slices_overlap",
+                  "gspmd_overlap"):
         ev = cfg[cname]["overlap"]
         if ev["reductions"] < 2:
             out.append(BudgetViolation(

@@ -43,6 +43,9 @@ KNOB_REGISTRY = {
     "DPTPU_SP": _k("int", "parallel"),
     "DPTPU_SP_MODE": _k("choice", "parallel"),
     "DPTPU_ZERO1": _k("bool", "parallel"),
+    "DPTPU_ZERO": _k("int", "parallel"),
+    "DPTPU_FSDP": _k("bool", "parallel"),
+    "DPTPU_RULES": _k("choice", "parallel"),
     "DPTPU_GSPMD": _k("bool", "parallel"),
     "DPTPU_SLICES": _k("int", "parallel"),
     "DPTPU_DCN_DTYPE": _k("choice", "parallel"),

@@ -1,0 +1,152 @@
+"""``partition-rules`` — the rules-table half of ``dptpu check``.
+
+Statically validates the per-family partition-rules tables
+(dptpu/models/registry.py) against the registry models they claim to
+place, so a stale table fails ``dptpu check`` BEFORE any bench or
+training run picks up a wrong placement:
+
+* every table is well-formed (``validate_rules``: ordered regexes, a
+  mandatory ``.*`` fallback, PartitionSpec/AUTO_FSDP values only);
+* every axis name a spec mentions is a mesh axis (``slice``/``data``/
+  ``model``) — a typo'd axis would only surface at jit time otherwise;
+* no dead rules: each non-fallback rule matches at least one leaf in
+  at least one of the family's structural representatives (Swin needs
+  BOTH v1 and v2 — ``logit_scale``/``cpb_mlp`` exist only in v2, and a
+  per-model census would flag those rows as dead on v1);
+* no fallback-only sharded families: a family that declares
+  model-axis (TP) rules must actually place leaves through them — a
+  module rename that silently demotes every kernel to the AUTO_FSDP
+  fallback is THE regression this rule exists to catch.
+
+Param trees come from ``jax.eval_shape`` over ``model.init`` — shapes
+only, nothing allocated — so the check stays cheap enough to run with
+the HLO budget gates (the jax half of ``dptpu check``; the stdlib-only
+``--no-hlo`` run skips it for the same reason it skips the budgets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+# the mesh vocabulary every spec must stay inside
+_MESH_AXES = ("slice", "data", "model")
+
+# structural representatives: the smallest registry model(s) covering
+# each family's module vocabulary. One per structure is enough — every
+# vit_* shares in_proj/out_proj/mlp_* names, every swin_v2_* carries
+# the v2-only leaves — and 4-variant coverage keeps the check seconds-
+# cheap where all 79 registry models would take minutes.
+FAMILY_REPRESENTATIVES: Dict[str, Tuple[str, ...]] = {
+    "vit": ("vit_b_32",),
+    "swin": ("swin_t", "swin_v2_t"),
+    "convnext": ("convnext_tiny",),
+    "generic": ("resnet18",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionViolation:
+    """One failed partition-rules gate — formats to an actionable line."""
+
+    family: str
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"partition-rules: [{self.family}] {self.rule}: "
+            f"{self.message} (fix the family's table in "
+            f"dptpu/models/registry.py — every placement consumer "
+            f"projects it)"
+        )
+
+
+def _family_params(arch: str):
+    """Shape-only param tree for a registry arch (nothing allocated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dptpu.models import create_model
+
+    model = create_model(arch)
+    shaped = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=False),
+        jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.float32),
+    )
+    return shaped["params"]
+
+
+def check_partition_rules() -> List[PartitionViolation]:
+    """Run every gate; [] means the tables and the zoo agree."""
+    from dptpu.models.registry import FAMILY_RULES
+    from dptpu.parallel.rules import (
+        AutoFsdp,
+        _entry_axes,
+        rule_match_counts,
+        validate_rules,
+    )
+
+    out: List[PartitionViolation] = []
+    for family, rules in sorted(FAMILY_RULES.items()):
+        try:
+            validate_rules(rules)
+        except ValueError as e:
+            out.append(PartitionViolation(family, "well-formed", str(e)))
+            continue
+        for pattern, spec in rules:
+            if isinstance(spec, AutoFsdp):
+                continue
+            bad = [a for entry in spec for a in _entry_axes(entry)
+                   if a not in _MESH_AXES]
+            if bad:
+                out.append(PartitionViolation(
+                    family, pattern,
+                    f"spec {spec} names non-mesh axes {bad} — the mesh "
+                    f"vocabulary is {'/'.join(_MESH_AXES)}",
+                ))
+        reps = FAMILY_REPRESENTATIVES[family]
+        # first-match-wins census, aggregated across the family's
+        # structural representatives (the dead-rule contract)
+        totals = [0] * len(rules)
+        for arch in reps:
+            counts = rule_match_counts(rules, _family_params(arch))
+            totals = [t + c for t, c in zip(totals, counts)]
+        non_fallback_leaves = sum(totals[:-1])
+        for i, (pattern, _) in enumerate(rules[:-1]):
+            if totals[i] == 0:
+                out.append(PartitionViolation(
+                    family, pattern,
+                    f"dead rule: matches zero leaves across "
+                    f"{'/'.join(reps)} — a module rename orphaned it",
+                ))
+        if len(rules) > 1 and non_fallback_leaves == 0:
+            out.append(PartitionViolation(
+                family, "*",
+                f"fallback-only family: every leaf of "
+                f"{'/'.join(reps)} fell through to the .* row — the "
+                f"declared sharding rules place nothing",
+            ))
+    return out
+
+
+def partition_summary(violations: List[PartitionViolation]) -> dict:
+    """The ANALYSIS.json block for the partition-rules half."""
+    from dptpu.models.registry import FAMILY_RULES
+    from dptpu.parallel.rules import rules_fingerprint
+
+    return {
+        "ok": not violations,
+        "violations": [v.format() for v in violations],
+        # the per-family table hashes — the same fingerprints the
+        # checkpoint sharding stamp carries, so a placement drift is
+        # diffable from the committed report alone
+        "fingerprints": {
+            family: rules_fingerprint(rules)
+            for family, rules in sorted(FAMILY_RULES.items())
+        },
+        "representatives": {
+            family: list(reps)
+            for family, reps in sorted(FAMILY_REPRESENTATIVES.items())
+        },
+    }

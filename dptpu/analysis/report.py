@@ -26,7 +26,8 @@ def build_report(root: str, run_hlo: bool = True,
                  computed: Optional[dict] = None) -> dict:
     """Run the full check and assemble the report. ``report["ok"]`` is
     the exit-code contract's single bit: True iff zero unsuppressed
-    lint findings AND (when run) zero HLO budget violations.
+    lint findings AND (when run) zero HLO budget violations AND zero
+    partition-rules violations.
     ``computed`` passes a fresh compile through to the budget gates
     (``--update-hlo-budgets`` reuses its own compile instead of paying
     four more)."""
@@ -50,15 +51,27 @@ def build_report(root: str, run_hlo: bool = True,
             budget_summary,
             check_hlo_budgets,
         )
+        from dptpu.analysis.partition import (
+            check_partition_rules,
+            partition_summary,
+        )
 
         violations, computed = check_hlo_budgets(
             root, budgets=budgets, computed=computed
         )
         report["hlo"] = budget_summary(violations, computed)
         ok = ok and not violations
+        # partition-rules rides the jax half: it needs eval_shape over
+        # the family representatives, so the --no-hlo stdlib-only run
+        # skips it the same way it skips the budget gates
+        p_violations = check_partition_rules()
+        report["partition_rules"] = partition_summary(p_violations)
+        ok = ok and not p_violations
     else:
         report["hlo"] = {"ok": None,
                          "note": "skipped (--no-hlo lint-only run)"}
+        report["partition_rules"] = {
+            "ok": None, "note": "skipped (--no-hlo lint-only run)"}
     report["ok"] = ok
     # stamped LAST so a full run records the jax the HLO gates actually
     # loaded (and a lint-only run honestly records None — provenance

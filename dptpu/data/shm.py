@@ -322,7 +322,10 @@ class SlotLease:
         self._pipe = pipe
         self.slot = slot
         self._gen = gen
-        self.released = False
+        # single-writer handoff: only the consumer that holds the lease
+        # flips it (idempotence guard); the ring side never writes it —
+        # revocation happens through the generation counter instead
+        self.released = False  # owned-by: consumer
 
     def release(self):
         if self.released:

@@ -8,7 +8,114 @@ in-tree Flax zoo: ``model_names()`` feeds the CLI ``choices`` and
 ``create_model(name)`` is the ``models.__dict__[arch]()`` analog.
 """
 
+from jax.sharding import PartitionSpec as P
+
+from dptpu.parallel.rules import AUTO_FSDP
+
 _REGISTRY = {}
+
+# --------------------------------------------------------------------------
+# Partition rules: ONE declaration per family covers DP x TP x FSDP.
+#
+# Each table is an ordered (regex, spec) list over the FULL {data, model}
+# axis vocabulary, resolved by dptpu/parallel/rules.py
+# ``match_partition_rules`` (first match wins against the "/"-joined param
+# path; the mandatory ``.*`` fallback closes every table). Consumers
+# PROJECT the one table onto their mesh: keep ``model`` and you get the
+# Megatron TP placement (the specs tests/test_gspmd.py locks, and what
+# serve uses); keep ``data`` and you get the ZeRO-3/FSDP layout; keep both
+# and one declaration yields the combined DPxTPxFSDP placement. The
+# ``(^|/)`` anchors pin whole path segments — ``proj`` must not claim
+# ``out_proj`` — reproducing the old per-module name checks exactly.
+#
+# Grammar per rule:
+#   P("data", "model")     kernel: dim0 FSDP-sharded, dim1 column-parallel
+#   P("model", "data")     kernel: dim0 row-parallel, dim1 FSDP-sharded
+#   P(("data", "model"))   bias of a column-parallel layer: its one dim
+#                          carries both axes (TP projection -> P("model"),
+#                          FSDP projection -> P("data"))
+#   P("data")              bias of a row-parallel layer: TP-replicated
+#   AUTO_FSDP              everything else: largest evenly-divisible dim
+#                          over ``data`` (mesh.largest_divisible_dim),
+#                          replicated under pure TP
+#
+# Family notes (the WHY lives with the old spec functions' docstrings,
+# now in dptpu/parallel/gspmd.py consumer docs): ViT and Swin fused-qkv
+# kernels are stored head-major, so the contiguous column split is
+# head-aligned; Swin v1's relative-position-bias table and v2's
+# logit_scale/cpb_mlp_2 shard on their heads dim (the variant-specific
+# rows are dead on the OTHER variant by construction — the check rule
+# aggregates liveness across the family, not per model); ConvNeXt only
+# TPs its pointwise MLP pair; classic CNNs and MaxViT take the pure
+# AUTO_FSDP table (conv TP is deliberately not shipped — see
+# gspmd.dp_specs).
+
+VIT_RULES = (
+    (r"(^|/)(in_proj|mlp_1)/kernel$", P("data", "model")),
+    (r"(^|/)(in_proj|mlp_1)/bias$", P(("data", "model"))),
+    (r"(^|/)(out_proj|mlp_2)/kernel$", P("model", "data")),
+    (r"(^|/)(out_proj|mlp_2)/bias$", P("data")),
+    (r".*", AUTO_FSDP),
+)
+
+SWIN_RULES = (
+    (r"(^|/)(qkv|cpb_mlp_2|mlp_1)/kernel$", P("data", "model")),
+    (r"(^|/)(qkv|cpb_mlp_2|mlp_1)/bias$", P(("data", "model"))),
+    (r"(^|/)(proj|mlp_2)/kernel$", P("model", "data")),
+    (r"(^|/)(proj|mlp_2)/bias$", P("data")),
+    (r"(^|/)logit_scale$", P("model")),
+    (r"(^|/)relative_position_bias_table$", P("data", "model")),
+    (r".*", AUTO_FSDP),
+)
+
+CONVNEXT_RULES = (
+    (r"(^|/)mlp_1/kernel$", P("data", "model")),
+    (r"(^|/)mlp_1/bias$", P(("data", "model"))),
+    (r"(^|/)mlp_2/kernel$", P("model", "data")),
+    (r"(^|/)mlp_2/bias$", P("data")),
+    (r".*", AUTO_FSDP),
+)
+
+GENERIC_RULES = ((r".*", AUTO_FSDP),)
+
+FAMILY_RULES = {
+    "vit": VIT_RULES,
+    "swin": SWIN_RULES,
+    "convnext": CONVNEXT_RULES,
+    "generic": GENERIC_RULES,
+}
+
+
+def partition_family(arch: str) -> str:
+    """Family key for an arch name — arch-name-only (no params needed)
+    so ``fit()`` can pick mesh geometry BEFORE model construction, the
+    same early-decision contract ``gspmd.tp_rule_for_arch`` keeps.
+
+    ``DPTPU_RULES=<family>`` overrides the name-derived family for EVERY
+    placement consumer at once (ZeRO-3, GSPMD, serve TP) — the escape
+    hatch for an arch whose name doesn't encode its structure (a custom
+    registry entry with ViT-shaped blocks can opt into the vit table
+    instead of the generic AUTO_FSDP fallback). Fail-fast contract: an
+    unknown family raises naming the valid choices."""
+    from dptpu.envknob import env_choice
+
+    override = env_choice("DPTPU_RULES", tuple(sorted(FAMILY_RULES)), None)
+    if override is not None:
+        return override
+    if arch.startswith("vit_"):
+        return "vit"
+    if arch.startswith("swin"):
+        return "swin"
+    if arch.startswith("convnext"):
+        return "convnext"
+    return "generic"
+
+
+def partition_rules_for_arch(arch: str):
+    """THE sharding declaration for an arch: its family's ordered rules
+    table. Every placement consumer (ZeRO-3 state layout, GSPMD/pjit
+    shardings, serve TP) projects this one table."""
+    return FAMILY_RULES[partition_family(arch)]
 
 
 def register_model(fn):

@@ -90,8 +90,11 @@ class Registry:
     different semantics is a bug, not a merge)."""
 
     def __init__(self):
-        self._metrics: Dict[str, object] = {}
-        self._sinks: list = []
+        # single-writer: instruments are registered and flushed from
+        # the train loop; background producers only mutate instrument
+        # VALUES (GIL-atomic float/int stores), never these containers
+        self._metrics: Dict[str, object] = {}  # owned-by: train-loop
+        self._sinks: list = []  # owned-by: train-loop
 
     def _get(self, name: str, cls):
         m = self._metrics.get(name)

@@ -27,22 +27,34 @@ from dptpu.parallel.mesh import (
     squeeze_axes,
 )
 from dptpu.parallel.gspmd import (
+    gspmd_specs_for_arch,
     make_gspmd_train_step,
     shard_gspmd_state,
     swin_tp_specs,
     vit_tp_specs,
 )
+from dptpu.parallel.rules import (
+    AUTO_FSDP,
+    match_partition_rules,
+    rules_fingerprint,
+)
 from dptpu.parallel.zero import (
     gather_state,
     make_zero1_train_step,
+    make_zero3_train_step,
     shard_zero1_state,
+    shard_zero3_state,
+    state_shard_bytes,
     zero1_sharded_fraction,
     zero1_state_specs,
     zero1_sumsq_reduce,
     zero1_update_shard_bytes,
+    zero3_param_specs,
+    zero3_state_specs,
 )
 
 __all__ = [
+    "AUTO_FSDP",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SLICE_AXIS",
@@ -51,6 +63,7 @@ __all__ = [
     "data_sharding",
     "dcn_reduce_shard",
     "gather_state",
+    "gspmd_specs_for_arch",
     "hierarchy_knobs",
     "initialize_distributed",
     "is_hierarchical",
@@ -59,15 +72,22 @@ __all__ = [
     "make_hierarchical_reduce",
     "make_mesh",
     "make_zero1_train_step",
+    "make_zero3_train_step",
+    "match_partition_rules",
     "replicated_sharding",
+    "rules_fingerprint",
     "shard_gspmd_state",
     "swin_tp_specs",
     "shard_host_batch",
     "shard_zero1_state",
+    "shard_zero3_state",
     "squeeze_axes",
+    "state_shard_bytes",
     "vit_tp_specs",
     "zero1_sharded_fraction",
     "zero1_state_specs",
     "zero1_sumsq_reduce",
     "zero1_update_shard_bytes",
+    "zero3_param_specs",
+    "zero3_state_specs",
 ]

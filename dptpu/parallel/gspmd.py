@@ -31,9 +31,32 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dptpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from dptpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_axis_names,
+    squeeze_axes,
+)
 
-# NOTE: dptpu.train imports stay lazy (same cycle as dptpu/parallel/zero.py).
+# NOTE: dptpu.train and dptpu.models imports stay lazy (same cycle rule as
+# dptpu/parallel/zero.py — and models.registry imports parallel.rules at
+# module scope, so a module-level registry import here would be circular).
+
+
+def _family_rules(family: str):
+    from dptpu.models.registry import FAMILY_RULES
+
+    return FAMILY_RULES[family]
+
+
+def _tp_project(rules, params):
+    """Project a family rules table onto the pure-TP view: keep only the
+    ``model`` axis, no divisibility clamp (mesh sizes that do not divide
+    still compile — GSPMD reshards — matching the historical hand-written
+    specs exactly, equality-locked in tests/test_gspmd.py)."""
+    from dptpu.parallel.rules import match_partition_rules
+
+    return match_partition_rules(rules, params, keep_axes=(MODEL_AXIS,))
 
 
 def dp_specs(params):
@@ -42,7 +65,8 @@ def dp_specs(params):
     in_shardings — the GSPMD/pjit expression of DDP, usable by all 79
     archs (the shard_map step in dptpu/train/step.py is the explicit
     twin). The partitioner derives the gradient all-reduce from the
-    shardings alone.
+    shardings alone. The GENERIC registry table projected onto the
+    model axis: ``AUTO_FSDP`` resolves to replicated under pure TP.
 
     Semantics note (same as the module docstring): under GSPMD the
     global batch is one logical program, so BatchNorm computes GLOBAL
@@ -58,25 +82,13 @@ def dp_specs(params):
     CNN channel counts (64-2048) are small enough that the data axis is
     always the profitable one on TPU. ViT encoder TP (below) is where
     the model axis earns its keep."""
-    return jax.tree_util.tree_map(lambda _: P(), params)
-
-
-def _mlp_pair_spec(names):
-    """Shared Megatron column→row rule for an ``mlp_1``/``mlp_2`` Dense
-    pair (the naming every transformer family in the zoo uses); None for
-    any other leaf so family rules can layer their own branches."""
-    mod = names[-2] if len(names) > 1 else ""
-    if mod == "mlp_1":  # column-parallel
-        return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
-    if mod == "mlp_2":  # row-parallel: split the input dim
-        return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
-    return None
+    return _tp_project(_family_rules("generic"), params)
 
 
 def vit_tp_specs(params):
-    """PartitionSpec tree for ViT: Megatron tensor parallelism over the
-    ``model`` axis for BOTH halves of every encoder layer, everything
-    else replicated.
+    """ViT Megatron TP placement — the registry ``VIT_RULES`` table
+    projected onto the ``model`` axis (dptpu/models/registry.py is the
+    declaration; this function remains the GSPMD/serve consumer name).
 
     MLP: first Linear column-parallel (kernel ``P(None, "model")``, bias
     ``P("model")``), second row-parallel (``P("model", None)``,
@@ -93,25 +105,12 @@ def vit_tp_specs(params):
     with its single all-reduce. Mesh sizes that do not divide ``heads``
     still compile (GSPMD reshards) but lose the alignment; ViT heads are
     12/16, so 2/4-way model axes are always aligned."""
-
-    def spec(path, leaf):
-        names = [p.key for p in path]
-        mlp = _mlp_pair_spec(names)
-        if mlp is not None:
-            return mlp
-        mod = names[-2] if len(names) > 1 else ""
-        if mod == "in_proj":  # column-parallel
-            return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
-        if mod == "out_proj":  # row-parallel: split the input dim
-            return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec, params)
+    return _tp_project(_family_rules("vit"), params)
 
 
 def swin_tp_specs(params):
-    """PartitionSpec tree for Swin v1/v2: Megatron tensor parallelism
-    over the ``model`` axis for every block, everything else replicated.
+    """Swin v1/v2 Megatron TP placement — the registry ``SWIN_RULES``
+    table projected onto the ``model`` axis.
 
     Same design as ``vit_tp_specs`` — the fused qkv kernel is stored
     head-major (dptpu/models/swin.py ``_QKVDense``), so its contiguous
@@ -120,7 +119,10 @@ def swin_tp_specs(params):
     per-head side tensors shard on their heads dim too: v1's
     relative-position-bias table, v2's ``logit_scale`` and the
     ``cpb_mlp_2`` head projection (its 512-wide input MLP stays
-    replicated — it is tiny). MLPs are column→row as usual.
+    replicated — it is tiny). MLPs are column→row as usual. The v1-only
+    and v2-only rows are dead on the other variant by construction —
+    the ``dptpu check`` partition-rules gate aggregates rule liveness
+    across the whole family, not per model.
 
     Head counts per stage are (3, 6, 12, 24)-shaped for t/s and
     (4, 8, 16, 32) for b: a model axis of 3 (t/s) or 4 (b) is aligned
@@ -131,30 +133,13 @@ def swin_tp_specs(params):
     [q|k|v]-major fused qkv and no TP spec — it is a conv-attention
     hybrid whose MBConv blocks dominate, so the data axis (``dp_specs``)
     is the profitable one there, same verdict as pure CNNs."""
-
-    def spec(path, leaf):
-        names = [p.key for p in path]
-        mlp = _mlp_pair_spec(names)
-        if mlp is not None:
-            return mlp
-        mod = names[-2] if len(names) > 1 else ""
-        if mod in ("qkv", "cpb_mlp_2"):  # column-parallel
-            return P(None, MODEL_AXIS) if names[-1] == "kernel" else P(MODEL_AXIS)
-        if mod == "proj":  # row-parallel: split the input dim
-            return P(MODEL_AXIS, None) if names[-1] == "kernel" else P()
-        if names[-1] == "logit_scale":  # (heads, 1, 1)
-            return P(MODEL_AXIS)
-        if names[-1] == "relative_position_bias_table":  # ((2w-1)^2, heads)
-            return P(None, MODEL_AXIS)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec, params)
+    return _tp_project(_family_rules("swin"), params)
 
 
 def convnext_tp_specs(params):
-    """PartitionSpec tree for ConvNeXt: Megatron column→row TP for every
-    block's MLP pair over the ``model`` axis, everything else
-    replicated.
+    """ConvNeXt Megatron TP placement — the registry ``CONVNEXT_RULES``
+    table projected onto the ``model`` axis: column→row TP for every
+    block's MLP pair, everything else replicated.
 
     The CNBlock is ``dwconv → LayerNorm → mlp_1 (C→4C) → GELU → mlp_2
     (4C→C) → layer_scale``: the FLOPs live in the two pointwise Linears,
@@ -166,13 +151,18 @@ def convnext_tp_specs(params):
     replicated. Any model-axis size dividing every stage's 4·dim is
     aligned: stage hiddens run 384→3072 (tiny/small), 512→4096 (base),
     768→6144 (large) — all divisible by 2/4/8."""
+    return _tp_project(_family_rules("convnext"), params)
 
-    def spec(path, leaf):
-        names = [p.key for p in path]
-        mlp = _mlp_pair_spec(names)
-        return mlp if mlp is not None else P()
 
-    return jax.tree_util.tree_map_with_path(spec, params)
+# Legacy rule-name surface: fit()'s verbose line, serve's placement
+# resolution and the spec tests all speak these names; each maps to the
+# family whose registry table it projects.
+_RULE_FOR_FAMILY = {
+    "vit": "vit_tp_specs",
+    "swin": "swin_tp_specs",
+    "convnext": "convnext_tp_specs",
+    "generic": "dp_specs",
+}
 
 
 def tp_rule_for_arch(arch: str) -> str:
@@ -186,22 +176,54 @@ def tp_rule_for_arch(arch: str) -> str:
     note) — answers ``dp_specs``. Arch-name-only so ``fit()`` can
     decide BEFORE mesh construction: a dp fallback should get the flat
     full-width data mesh, not a factored one with a redundant model
-    axis."""
-    if arch.startswith("vit_"):
-        return "vit_tp_specs"
-    if arch.startswith("swin"):
-        return "swin_tp_specs"
-    if arch.startswith("convnext"):
-        return "convnext_tp_specs"
-    return "dp_specs"
+    axis. Family membership is the registry's
+    ``partition_family`` — the one declaration point."""
+    from dptpu.models.registry import partition_family
+
+    return _RULE_FOR_FAMILY[partition_family(arch)]
 
 
 def tp_specs_for_arch(arch: str, params):
     """``(rule_name, specs)`` for ``tp_rule_for_arch``'s choice."""
-    rule = tp_rule_for_arch(arch)
-    fn = {"vit_tp_specs": vit_tp_specs, "swin_tp_specs": swin_tp_specs,
-          "convnext_tp_specs": convnext_tp_specs, "dp_specs": dp_specs}[rule]
-    return rule, fn(params)
+    from dptpu.models.registry import partition_family
+
+    family = partition_family(arch)
+    return _RULE_FOR_FAMILY[family], _tp_project(_family_rules(family), params)
+
+
+def gspmd_specs_for_arch(arch: str, params, mesh: Mesh, *,
+                         tp: bool = False, fsdp: bool = False):
+    """The arch's registry rules table projected onto THIS mesh — the
+    general GSPMD placement (``tp_specs_for_arch`` is the pure-TP
+    special case kept for its locked name surface).
+
+    ``fsdp=True`` keeps the ``data`` axis: params shard over the
+    intra-slice data axis and the SPMD partitioner derives the ZeRO-3
+    communication pattern itself — all-gather at use, reduce-scatter
+    for the grads. On a ``{slice, data}``-factored mesh that is the
+    hierarchical decomposition (RS over ICI, shard-sized AR over DCN,
+    AG over ICI) the shard_map path hand-places; here the placement
+    declaration alone produces it. ``tp=True`` keeps ``model``. FSDP
+    projections clamp to mesh-size divisibility (clean tiles keep the
+    per-link HLO budgets exact; a non-dividing leaf degrades to
+    replicated, same as the shard_map paths)."""
+    from dptpu.models.registry import partition_rules_for_arch
+    from dptpu.parallel.rules import match_partition_rules
+
+    keep = []
+    if fsdp:
+        keep.append(DATA_AXIS)
+    if tp:
+        keep.append(MODEL_AXIS)
+    if not keep:
+        return dp_specs(params)
+    clamp = None
+    if fsdp:
+        clamp = {a: int(mesh.shape[a]) for a in keep if a in mesh.shape}
+    return match_partition_rules(
+        partition_rules_for_arch(arch), params,
+        keep_axes=tuple(keep), clamp=clamp,
+    )
 
 
 def _opt_shardings(opt_state, pshard, rep):
@@ -238,27 +260,67 @@ def shard_gspmd_state(state, mesh: Mesh, param_specs):
     )
 
 
+def make_gspmd_bucket_reduce(mesh: Mesh):
+    """Per-bucket gradient boundary for the GSPMD path.
+
+    The shard_map overlap buckets call ``lax.psum`` explicitly; under
+    plain ``jit`` there is no bound axis name to psum over, so the
+    GSPMD spelling is a sharding CONSTRAINT: concat the bucket's grads
+    flat and pin the result replicated. The partitioner must therefore
+    materialize the fully-reduced value at that point — one fused
+    all-reduce per bucket — and because ``OverlapPlan.wrap`` anchors
+    this inside the backward via the per-bucket custom-VJP identity,
+    the bucket reductions are scheduled interleaved with remaining
+    backward compute instead of as one post-backward monolith
+    (gated by ``hlo_accounting.overlap_evidence`` exactly as for
+    shard_map, in the ``gspmd_overlap`` HLO budget config)."""
+    from dptpu.parallel.overlap import _concat_flat, _split_flat
+
+    rep = NamedSharding(mesh, P())
+
+    def reduce_bucket(cts, idxs):
+        vec = jax.lax.with_sharding_constraint(_concat_flat(cts), rep)
+        return _split_flat(vec, cts)
+
+    return reduce_bucket
+
+
 def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
                           compute_dtype=jnp.float32, lr_schedule=None,
                           seed: int = 0, accum_steps: int = 1,
-                          label_smoothing: float = 0.0):
+                          label_smoothing: float = 0.0,
+                          overlap: bool = False,
+                          bucket_bytes: int = None):
     """Single-program train step partitioned by XLA.
 
     Same contract as ``make_train_step``: ``step(state, batch) ->
-    (state, metrics)``; ``batch`` is the GLOBAL batch (sharded
-    ``P("data")`` on entry), metrics are global scalars. The gradient
-    all-reduce over ``data`` and the TP all-reduces over ``model`` are
-    inserted by the SPMD partitioner — there is no collective in this
-    source; that also covers the LARS/LAMB per-layer norms (global
-    reductions the partitioner lowers itself — no ``sumsq_reduce``
-    hook needed) and gradient accumulation (``accum_steps=k`` scans
-    GLOBAL microbatches of ``B/k``; BN stays global-per-microbatch,
-    the SyncBN semantics this path always has).
+    (state, metrics)``; ``batch`` is the GLOBAL batch (sharded over the
+    mesh's data axes on entry — ``P("data")`` flat, ``P(("slice",
+    "data"))`` on a hierarchical mesh), metrics are global scalars. The
+    gradient all-reduce over ``data`` and the TP all-reduces over
+    ``model`` are inserted by the SPMD partitioner — there is no
+    collective in this source; that also covers the LARS/LAMB per-layer
+    norms (global reductions the partitioner lowers itself — no
+    ``sumsq_reduce`` hook needed) and gradient accumulation
+    (``accum_steps=k`` scans GLOBAL microbatches of ``B/k``; BN stays
+    global-per-microbatch, the SyncBN semantics this path always has).
+
+    ``overlap=True`` buckets the gradient reductions
+    (``make_gspmd_bucket_reduce``): per-bucket custom-VJP boundaries in
+    the backward, replicated-constraint reductions the partitioner
+    fuses one-per-bucket — PR 13's bucketing carried over to the pjit
+    path.
     """
+    from dptpu.parallel.overlap import DEFAULT_BUCKET_MB, OverlapPlan
     from dptpu.train.step import train_step_body, tpu_compiler_options
 
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
+    overlap_plan = None
+    if overlap:
+        if bucket_bytes is None:
+            bucket_bytes = int(DEFAULT_BUCKET_MB * 1024 * 1024)
+        overlap_plan = OverlapPlan(bucket_bytes, make_gspmd_bucket_reduce(mesh))
 
     def step(state, batch):
         # one logical program over the global batch: the shared step body
@@ -268,12 +330,14 @@ def make_gspmd_train_step(mesh: Mesh, state_template, param_specs,
             state, batch, compute_dtype=compute_dtype,
             lr_schedule=lr_schedule, seed=seed, axis_size=1, on_mesh=False,
             accum_steps=accum_steps, label_smoothing=label_smoothing,
+            overlap_plan=overlap_plan,
         )
 
     st_shardings = state_shardings(state_template, mesh, param_specs)
+    batch_spec = P(squeeze_axes(data_axis_names(mesh)))
     batch_shardings = {
-        "images": NamedSharding(mesh, P(DATA_AXIS)),
-        "labels": NamedSharding(mesh, P(DATA_AXIS)),
+        "images": NamedSharding(mesh, batch_spec),
+        "labels": NamedSharding(mesh, batch_spec),
     }
     rep = NamedSharding(mesh, P())
     metric_keys = ["loss", "top1", "top5", "lr"]

@@ -68,7 +68,7 @@ from dptpu.parallel.mesh import (
     largest_divisible_dim,
 )
 
-DCN_DTYPES = ("fp32", "bf16")
+DCN_DTYPES = ("fp32", "bf16", "bf16_a2a")
 
 
 def hierarchy_knobs(cfg=None) -> tuple:
@@ -80,8 +80,13 @@ def hierarchy_knobs(cfg=None) -> tuple:
       single-level mesh) and must divide the world size (checked where
       the device count is known: ``make_hierarchical_mesh``).
     * ``DPTPU_DCN_DTYPE`` — ``fp32`` (default: the DCN all-reduce runs
-      at full precision) or ``bf16`` (gather-based compression of the
-      cross-slice hop, fp32 accumulation; see module docstring).
+      at full precision), ``bf16`` (gather-based compression of the
+      cross-slice hop, fp32 accumulation; see module docstring), or
+      ``bf16_a2a`` (all-to-all + local-accumulate: per-chip DCN bytes
+      ~half the fp32 all-reduce at ANY slice count — gather-bf16's
+      ``(S-1)·m`` receive volume loses to the fp32 all-reduce past S=4,
+      the documented ceiling this mode breaks — at the cost of a second
+      bf16 rounding on the reduced sum; see ``dcn_reduce_shard``).
     """
     from dptpu.envknob import env_choice, env_int
 
@@ -137,7 +142,7 @@ def _scatter_dim(shape, n: int) -> int:
 
 
 def dcn_reduce_shard(x, slices_axis: str = SLICE_AXIS,
-                     dcn_dtype: str = "fp32"):
+                     dcn_dtype: str = "fp32", slices: Optional[int] = None):
     """The cross-slice (DCN) hop for one already-scattered shard.
 
     fp32: a plain shard-sized ``psum`` over the slice axis. bf16: round
@@ -146,12 +151,58 @@ def dcn_reduce_shard(x, slices_axis: str = SLICE_AXIS,
     it), and sum them locally in fp32, slice-major — fp32 accumulation
     with a deterministic order. Non-float32 shards (none in practice:
     grads follow the f32 params) pass through the fp32 path.
+
+    bf16_a2a (arXiv:1903.12650's reduced-precision exchange married to
+    a scatter-reduce): the shard flattens, pads to a multiple of S and
+    splits into S chunks; one bf16 **all-to-all** gives each slice the
+    S partials of ITS chunk, which it sums locally in fp32 (slice-major,
+    deterministic), then a chunk-sized bf16 all-gather redistributes the
+    reduced chunks. Per-chip DCN receive bytes are ``2·(S-1)/S·m`` bf16
+    ≈ HALF the fp32 all-reduce's ``2·(S-1)/S·m`` fp32 at ANY S — unlike
+    gather-bf16, whose ``(S-1)·m`` receive volume crosses the fp32
+    all-reduce at S=4 (the ceiling this mode breaks). The price is a
+    SECOND rounding: the fp32-accumulated chunk sum rounds to bf16 for
+    the gather hop, where gather-bf16 rounds only the inputs. Needs the
+    concrete slice count (``slices`` — a reshape extent; callers read it
+    off the mesh) because axis sizes are not Python ints under tracing.
     """
     if dcn_dtype == "bf16" and x.dtype == jnp.float32:
         parts = lax.all_gather(
             x.astype(jnp.bfloat16), slices_axis, axis=0, tiled=False
         )
         return jnp.sum(parts.astype(jnp.float32), axis=0)
+    if dcn_dtype == "bf16_a2a" and x.dtype == jnp.float32:
+        if not slices or slices < 1:
+            raise ValueError(
+                "dcn_dtype='bf16_a2a' needs the concrete slice count: "
+                "pass slices=int(mesh.shape['slice']) (the chunk split "
+                "is a reshape, and axis sizes are traced values inside "
+                "shard_map)"
+            )
+        if slices == 1:
+            return x  # single slice: the DCN hop is the identity
+        shape = x.shape
+        flat = x.reshape(-1)
+        m = flat.shape[0]
+        pad = (-m) % slices
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)]
+            )
+        chunks = flat.reshape(slices, -1).astype(jnp.bfloat16)
+        # chunk j of every slice travels to slice j: row k of the result
+        # is slice k's partial of MY chunk
+        parts = lax.all_to_all(
+            chunks, slices_axis, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(slices, -1)
+        mine = jnp.sum(parts.astype(jnp.float32), axis=0)
+        # second rounding: the reduced chunk goes back over DCN in bf16
+        full = lax.all_gather(
+            mine.astype(jnp.bfloat16), slices_axis, axis=0, tiled=False
+        ).astype(jnp.float32).reshape(-1)
+        if pad:
+            full = full[:m]
+        return full.reshape(shape)
     return lax.psum(x, slices_axis)
 
 
@@ -172,6 +223,7 @@ def make_hierarchical_reduce(mesh: Mesh, dcn_dtype: str = "fp32"):
             + "/".join(repr(d) for d in DCN_DTYPES)
         )
     n_in = int(mesh.shape[DATA_AXIS])
+    n_slices = int(mesh.shape[SLICE_AXIS])
 
     def reduce_grads(grads):
         def red(g):
@@ -182,7 +234,8 @@ def make_hierarchical_reduce(mesh: Mesh, dcn_dtype: str = "fp32"):
             sh = lax.psum_scatter(
                 g, DATA_AXIS, scatter_dimension=d, tiled=True
             )
-            sh = dcn_reduce_shard(sh, SLICE_AXIS, dcn_dtype)
+            sh = dcn_reduce_shard(sh, SLICE_AXIS, dcn_dtype,
+                                  slices=n_slices)
             return lax.all_gather(sh, DATA_AXIS, axis=d, tiled=True)
 
         return jax.tree_util.tree_map(red, grads)

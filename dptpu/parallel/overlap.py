@@ -189,7 +189,8 @@ def _split_flat(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
 
 
 def hier_ladder_flat(flat: jax.Array, inner: int,
-                     dcn_dtype: str = "fp32") -> jax.Array:
+                     dcn_dtype: str = "fp32",
+                     slices: Optional[int] = None) -> jax.Array:
     """The PR-10 three-hop ladder on one flat bucket buffer:
     reduce-scatter(ICI) → shard-sized DCN hop → all-gather(ICI).
 
@@ -206,13 +207,14 @@ def hier_ladder_flat(flat: jax.Array, inner: int,
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     shard = lax.psum_scatter(flat, DATA_AXIS, scatter_dimension=0,
                              tiled=True)
-    shard = dcn_reduce_shard(shard, SLICE_AXIS, dcn_dtype)
+    shard = dcn_reduce_shard(shard, SLICE_AXIS, dcn_dtype, slices=slices)
     full = lax.all_gather(shard, DATA_AXIS, axis=0, tiled=True)
     return full[:n] if pad else full
 
 
 def make_ddp_bucket_reduce(hier: bool, dcn_dtype: str = "fp32",
-                           inner: Optional[int] = None) -> Callable:
+                           inner: Optional[int] = None,
+                           slices: Optional[int] = None) -> Callable:
     """The per-bucket reduction for the DDP step families.
 
     Flat mesh: one ``psum`` of the concatenated bucket over the data
@@ -236,7 +238,7 @@ def make_ddp_bucket_reduce(hier: bool, dcn_dtype: str = "fp32",
     def reduce_bucket(cts: List[jax.Array], idxs: List[int]):
         flat = _concat_flat(cts)
         if hier:
-            red = hier_ladder_flat(flat, inner, dcn_dtype)
+            red = hier_ladder_flat(flat, inner, dcn_dtype, slices=slices)
         else:
             red = lax.psum(flat, DATA_AXIS)
         return _split_flat(red, cts)
@@ -245,8 +247,10 @@ def make_ddp_bucket_reduce(hier: bool, dcn_dtype: str = "fp32",
 
 
 def make_zero1_bucket_reduce(sharded_flags: Sequence[bool], hier: bool,
-                             dcn_dtype: str = "fp32") -> Callable:
-    """The per-bucket reduction for the ZeRO-1 step.
+                             dcn_dtype: str = "fp32",
+                             slices: Optional[int] = None) -> Callable:
+    """The per-bucket reduction for the ZeRO-1 (and ZeRO-3 — the engine
+    is layout-agnostic, it only needs the sharded flags) step.
 
     The cotangents arriving here are what the weight all-gather's VJP
     produced: sharded leaves are ALREADY reduce-scattered over the
@@ -267,7 +271,8 @@ def make_zero1_bucket_reduce(sharded_flags: Sequence[bool], hier: bool,
         repl_pos = [k for k, i in enumerate(idxs) if not sharded_flags[i]]
         if hier and shard_pos:
             flat = _concat_flat([cts[k] for k in shard_pos])
-            red = dcn_reduce_shard(flat, SLICE_AXIS, dcn_dtype)
+            red = dcn_reduce_shard(flat, SLICE_AXIS, dcn_dtype,
+                                   slices=slices)
             for k, r in zip(shard_pos,
                             _split_flat(red, [cts[k] for k in shard_pos])):
                 out[k] = r

@@ -1,0 +1,280 @@
+"""Unified partition-rule sharding engine: ONE ordered regex ->
+``PartitionSpec`` table drives every placement in the repo.
+
+Before this module the repo carried three parallel sharding
+vocabularies: the shard_map DDP/ZeRO step bodies picked shard dims with
+``zero._leaf_spec``, the GSPMD path hand-wrote a per-family spec
+function (``vit_tp_specs`` et al.), and serve duplicated the TP
+placement through the same hand-written functions. Each was correct in
+isolation and none could see the others — adding a model family meant
+三 separate spec edits. The fix is the fjformer/EasyLM idiom
+(SNIPPETS.md [1]): an ordered table of ``(regex, PartitionSpec)`` rules
+matched against the "/"-joined parameter path, first match wins, with a
+mandatory ``.*`` fallback. Every placement consumer — ZeRO-1/ZeRO-3
+state layout, the GSPMD/pjit shardings (DP, TP, hierarchical FSDP), and
+serve's TP placement — resolves through ``match_partition_rules`` here;
+the per-family tables themselves live next to the model registry
+(``dptpu/models/registry.py FAMILY_RULES``) so a new family declares
+its placement ONCE.
+
+Grammar: rule specs name axes from the full ``{slice, data, model}``
+vocabulary — ``data`` is the FSDP/ZeRO axis, ``model`` the tensor-
+parallel axis (compound entries like ``("data", "model")`` shard one
+dim over both). A CONSUMER then projects the table onto the axes its
+mesh actually opens (``keep_axes``) and optionally clamps to
+divisibility (``clamp`` — the shard_map paths need even tiles; GSPMD
+tolerates uneven shards but clean tiles keep the HLO budgets exact).
+One table therefore yields the pure-TP specs (project to ``model``),
+the ZeRO-3/FSDP layout (project to ``data``), and the combined DPxTPx
+FSDP placement (keep both) — placements cannot drift apart because
+they are projections of the same declaration.
+
+``AUTO_FSDP`` is the table-side spelling of the repo's ONE shard-dim
+selection rule (``mesh.largest_divisible_dim``): "shard this leaf's
+largest evenly-divisible dim over the data axis". The generic CNN table
+is exactly ``((".*", AUTO_FSDP),)``, which makes the rules-driven
+ZeRO-1/ZeRO-3 layout bit-identical to the historical ``_leaf_spec``
+behavior for every architecture without a family table.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from dptpu.parallel.mesh import DATA_AXIS, largest_divisible_dim
+
+
+class AutoFsdp:
+    """Sentinel rule value: shard the leaf's largest evenly-divisible
+    dim over the data axis (``mesh.largest_divisible_dim`` — the shared
+    dim-selection rule ZeRO-1 has always used). Resolves to ``P()``
+    when the consumer's projection drops the data axis (pure TP) or no
+    dim divides (tiny biases)."""
+
+    def __repr__(self) -> str:  # stable for rules_fingerprint
+        return "AUTO_FSDP"
+
+
+AUTO_FSDP = AutoFsdp()
+
+
+def fsdp_auto_spec(shape, n: int) -> P:
+    """``AUTO_FSDP`` resolved for one leaf: ``P(*Nones, "data")`` on
+    its largest dim divisible by ``n``, ``P()`` when none divides. THE
+    dim-selection rule (``mesh.largest_divisible_dim``) — ZeRO-1's
+    ``_leaf_spec`` resolves through here, so the table's fallback and
+    the legacy layout cannot desynchronize."""
+    best = largest_divisible_dim(tuple(shape), n)
+    if best < 0:
+        return P()
+    return P(*([None] * best), DATA_AXIS)
+
+
+def _canonical(entries: Sequence) -> P:
+    """Normalize a projected entry list to the repo's canonical spec
+    spelling: 1-tuples collapse to the bare axis name, empty tuples to
+    ``None``, and an all-``None`` spec to ``P()`` (the forms the
+    locked spec-equality tests compare against — ``PartitionSpec``
+    equality is strict, ``P(None) != P()``)."""
+    out = []
+    for e in entries:
+        if isinstance(e, tuple):
+            e = e[0] if len(e) == 1 else (None if not e else e)
+        out.append(e)
+    if all(e is None for e in out):
+        return P()
+    return P(*out)
+
+
+def _entry_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def project_spec(spec: P, keep_axes: Sequence[str]) -> P:
+    """Keep only ``keep_axes`` names in ``spec`` (compound entries
+    filter member-wise), canonicalized. The consumer-side projection:
+    the table speaks the full axis vocabulary; a mesh that only opens
+    ``model`` projects everything else away."""
+    keep = set(keep_axes)
+    out = []
+    for entry in spec:
+        names = tuple(a for a in _entry_axes(entry) if a in keep)
+        out.append(names if names else None)
+    return _canonical(out)
+
+
+def clamp_spec(spec: P, shape, sizes: Dict[str, int]) -> P:
+    """Drop axis names whose mesh size does not evenly divide the dim
+    they shard (compound entries drop members from the END until the
+    product divides), and names missing from ``sizes`` entirely. The
+    shard_map consumers (ZeRO-3's explicit tiled all-gather) REQUIRE
+    even tiles; an undivisible leaf degrades to replicated exactly
+    like the legacy ``_leaf_spec`` remainder."""
+    out = []
+    for d, entry in enumerate(spec):
+        if d >= len(shape):
+            out.append(None)
+            continue
+        names = [a for a in _entry_axes(entry) if a in sizes]
+        while names:
+            prod = 1
+            for a in names:
+                prod *= int(sizes[a])
+            if prod > 0 and shape[d] % prod == 0:
+                break
+            names.pop()
+        out.append(tuple(names) if names else None)
+    return _canonical(out)
+
+
+def _leaf_paths(params) -> Tuple[list, list, "jax.tree_util.PyTreeDef"]:
+    """(path_strings, leaves, treedef) — paths are the "/"-joined flax
+    key chain the rule regexes match against."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths, leaves = [], []
+    for path, leaf in flat:
+        parts = []
+        for k in path:
+            parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+        paths.append("/".join(parts))
+        leaves.append(leaf)
+    return paths, leaves, treedef
+
+
+def validate_rules(rules: Sequence[tuple]) -> None:
+    """Static table checks: every pattern compiles, every value is a
+    ``PartitionSpec`` or ``AUTO_FSDP``, and the LAST rule is the
+    mandatory ``.*`` fallback (a table without one would leave
+    unmatched leaves to a runtime surprise; the fallback makes the
+    default placement an explicit, reviewable declaration)."""
+    if not rules:
+        raise ValueError("empty partition-rules table — at minimum the "
+                         "mandatory ('.*', ...) fallback rule is required")
+    for pat, val in rules:
+        try:
+            re.compile(pat)
+        except re.error as e:
+            raise ValueError(
+                f"partition rule pattern {pat!r} does not compile: {e}"
+            ) from e
+        if not isinstance(val, (P, AutoFsdp)):
+            raise ValueError(
+                f"partition rule {pat!r} maps to {type(val).__name__}, "
+                f"expected PartitionSpec or AUTO_FSDP"
+            )
+    if rules[-1][0] != ".*":
+        raise ValueError(
+            "partition-rules table must END with the mandatory ('.*', "
+            f"...) fallback rule, got {rules[-1][0]!r} last — the "
+            "default placement is part of the declaration, not an "
+            "accident"
+        )
+
+
+def rule_match_counts(rules: Sequence[tuple], params) -> List[int]:
+    """How many leaves each rule claimed under first-match-wins — the
+    dead-rule census (`dptpu check` partition-rules aggregates this
+    across every model of a family; a rule matching zero leaves in ALL
+    of them is dead weight or a stale regex)."""
+    validate_rules(rules)
+    paths, _, _ = _leaf_paths(params)
+    counts = [0] * len(rules)
+    for path in paths:
+        for i, (pat, _) in enumerate(rules):
+            if re.search(pat, path):
+                counts[i] += 1
+                break
+    return counts
+
+
+def match_partition_rules(rules: Sequence[tuple], params, *,
+                          keep_axes: Optional[Sequence[str]] = None,
+                          clamp: Optional[Dict[str, int]] = None,
+                          strict_dead: bool = False):
+    """Resolve the ordered rules table over a parameter pytree.
+
+    Returns a params-structured tree of ``PartitionSpec``. Each leaf's
+    "/"-joined path is tested against the rule regexes IN ORDER
+    (``re.search``) and the first match wins; the table must end with
+    the mandatory ``.*`` fallback (``validate_rules``). ``keep_axes``
+    projects the matched specs onto the consumer's axes (None keeps
+    all); ``clamp`` maps axis name -> mesh size and drops entries that
+    do not evenly divide their dim (required by the shard_map
+    consumers). ``AUTO_FSDP`` values resolve through
+    ``fsdp_auto_spec`` using ``clamp``'s data-axis size (and to
+    ``P()`` when the projection drops the data axis).
+
+    ``strict_dead=True`` additionally raises when any non-fallback
+    rule matched zero leaves — the single-model strictness the
+    matcher unit tests lock; family tables spanning model VARIANTS
+    (e.g. swin v1's bias table vs v2's logit_scale) aggregate
+    liveness across models via ``rule_match_counts`` instead.
+
+    Raises on an unmatched leaf (impossible with the mandatory
+    fallback, kept as defense for hand-built partial tables that
+    bypass ``validate_rules``).
+    """
+    validate_rules(rules)
+    keep = None if keep_axes is None else set(keep_axes)
+    data_n = int(clamp[DATA_AXIS]) if clamp and DATA_AXIS in clamp else None
+    compiled = [(re.compile(pat), val) for pat, val in rules]
+    paths, leaves, treedef = _leaf_paths(params)
+    counts = [0] * len(rules)
+    out = []
+    for path, leaf in zip(paths, leaves):
+        spec = None
+        for i, (rx, val) in enumerate(compiled):
+            if rx.search(path):
+                counts[i] += 1
+                shape = tuple(getattr(leaf, "shape", ()))
+                if isinstance(val, AutoFsdp):
+                    use_auto = (data_n is not None
+                                and (keep is None or DATA_AXIS in keep))
+                    spec = fsdp_auto_spec(shape, data_n) if use_auto \
+                        else P()
+                else:
+                    spec = val
+                    if keep is not None:
+                        spec = project_spec(spec, keep)
+                    if clamp is not None:
+                        spec = clamp_spec(spec, shape, clamp)
+                break
+        if spec is None:
+            raise ValueError(
+                f"no partition rule matched parameter {path!r} — add a "
+                f"rule for it or restore the mandatory ('.*', ...) "
+                f"fallback"
+            )
+        out.append(spec)
+    if strict_dead:
+        dead = [rules[i][0] for i in range(len(rules) - 1)
+                if counts[i] == 0]
+        if dead:
+            raise ValueError(
+                f"dead partition rule(s) {dead!r}: matched zero leaves "
+                f"of this parameter tree — stale regex or a renamed "
+                f"module; fix or remove them (the '.*' fallback is "
+                f"exempt)"
+            )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def rules_fingerprint(rules: Sequence[tuple]) -> str:
+    """Stable 12-hex digest of a rules table — the sharding half of the
+    checkpoint geometry stamp (train/checkpoint.py): a ``--resume``
+    across a CHANGED table fail-fasts naming both fingerprints instead
+    of loading state whose shard layout silently moved."""
+    h = hashlib.sha256()
+    for pat, val in rules:
+        h.update(pat.encode())
+        h.update(b"\x00")
+        h.update(repr(val).encode())
+        h.update(b"\x01")
+    return h.hexdigest()[:12]

@@ -61,7 +61,6 @@ from dptpu.parallel.mesh import (
     SLICE_AXIS,
     data_axis_names,
     data_parallel_width,
-    largest_divisible_dim,
     squeeze_axes,
 )
 
@@ -81,11 +80,13 @@ def _leaf_spec(leaf, n: int) -> P:
     of the total (see ``zero1_sharded_fraction``). The dim-selection
     rule is the SHARED ``mesh.largest_divisible_dim`` — the
     hierarchical reduce-scatter resolves through the same function, so
-    its gradient shard is the update shard by construction."""
-    best = largest_divisible_dim(getattr(leaf, "shape", ()), n)
-    if best < 0:
-        return P()
-    return P(*([None] * best), DATA_AXIS)
+    its gradient shard is the update shard by construction. Delegates
+    to ``rules.fsdp_auto_spec`` — the same resolver the rules tables'
+    ``AUTO_FSDP`` fallback uses — so the ZeRO-1 layout and the
+    table-driven placements share one implementation."""
+    from dptpu.parallel.rules import fsdp_auto_spec
+
+    return fsdp_auto_spec(getattr(leaf, "shape", ()), n)
 
 
 def _sharded_axis(spec: P) -> int:
@@ -214,6 +215,250 @@ def zero1_update_shard_bytes(state, mesh: Mesh) -> int:
     )
 
 
+# --------------------------------------------------------------------------
+# ZeRO-3 / FSDP: the rules-table generalization of the weight-update
+# sharding above. ZeRO-1's placement is the per-leaf ``_leaf_spec``
+# heuristic; ZeRO-3 instead resolves the arch's REGISTRY rules table
+# (dptpu/models/registry.py FAMILY_RULES projected onto the data axis via
+# dptpu/parallel/rules.py), so the FSDP shard dims are the ones the
+# family declaration picked to compose with tensor parallelism, and the
+# forward/backward boundary is an EXPLICIT custom-VJP pair: forward
+# all-gather, backward psum_scatter — the backward gather IS the
+# reduce-scatter, stated in source rather than inherited from the
+# all-gather's VJP. Grads therefore stay shard-sized through the
+# accumulation scan, the fp32 optimizer state stays shard-sized, and the
+# per-chip params+grads+opt-state footprint is ~1/N (gated in SCALEBENCH
+# and the ``zero3`` HLO budget config).
+#
+# make_zero1_train_step above is deliberately untouched: its compiled
+# program is exact-matched by HLO_BUDGETS.json.
+
+
+def zero3_param_specs(arch: str, params, mesh: Mesh):
+    """The arch's registry rules table projected onto the intra-slice
+    data axis — THE ZeRO-3 placement. Clamped to mesh-size
+    divisibility (the tiled all-gather boundary needs even tiles; a
+    non-dividing leaf degrades to replicated exactly like
+    ``_leaf_spec``'s remainder). ``AUTO_FSDP`` rows resolve through the
+    same ``largest_divisible_dim`` rule ZeRO-1 uses, so for a generic
+    CNN this tree is bit-identical to ``zero1_state_specs``' params."""
+    from dptpu.models.registry import partition_rules_for_arch
+    from dptpu.parallel.rules import match_partition_rules
+
+    n = int(mesh.shape[DATA_AXIS])
+    return match_partition_rules(
+        partition_rules_for_arch(arch), params,
+        keep_axes=(DATA_AXIS,), clamp={DATA_AXIS: n},
+    )
+
+
+def zero3_state_specs(state, mesh: Mesh, param_specs):
+    """TrainState-shaped spec tree for the ZeRO-3 layout: params follow
+    the rules-table placement, momentum mirrors it STRUCTURALLY
+    (``map_momentum`` — the update is shard-local, so the fp32 state
+    lives exactly where its param shard lives), everything else
+    replicated."""
+    from dptpu.train.state import map_momentum
+
+    return state.replace(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
+        opt_state=map_momentum(
+            state.opt_state, lambda _: param_specs, lambda _: P()
+        ),
+    )
+
+
+def shard_zero3_state(state, mesh: Mesh, param_specs):
+    """Place a (replicated) TrainState into the ZeRO-3 layout (see
+    ``shard_zero1_state`` for the donation caveat — step only the
+    returned state). Re-sharding an already-placed state is fine:
+    ``device_put`` moves it — this is what the elastic resume path does
+    after a geometry change."""
+    specs = zero3_state_specs(state, mesh, param_specs)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+
+
+def state_shard_bytes(state, mesh: Mesh, specs) -> int:
+    """Per-chip bytes of params + optimizer state under an explicit
+    TrainState-shaped spec tree (``zero3_state_specs`` result) — the
+    SCALEBENCH 1/N gate's numerator. Same accounting contract as
+    ``zero1_update_shard_bytes``: sharded leaves count 1/N, replicated
+    in full; N=1 (or an all-replicated spec tree) gives the DDP
+    baseline."""
+    n = int(mesh.shape[DATA_AXIS])
+    total = 0
+    for part in ("params", "opt_state"):
+        leaves = jax.tree_util.tree_leaves(getattr(state, part))
+        spec_leaves = jax.tree_util.tree_leaves(
+            getattr(specs, part), is_leaf=lambda x: isinstance(x, P)
+        )
+        for leaf, spec in zip(leaves, spec_leaves):
+            nbytes = int(np.prod(leaf.shape) if leaf.shape else 1) * (
+                jnp.dtype(leaf.dtype).itemsize
+            )
+            total += nbytes // n if _sharded_axis(spec) >= 0 else nbytes
+    return total
+
+
+_GATHER_CACHE = {}
+
+
+def _zero3_gather(d: int):
+    """The explicit ZeRO-3 boundary for a leaf sharded on dim ``d``:
+    forward is the tiled all-gather (full params on every device, used
+    and discarded within the step), backward is the tiled
+    ``psum_scatter`` on the SAME dim — each device receives exactly its
+    shard of the global gradient sum, so the gradient is never
+    materialized unsharded. This is what the all-gather's derived VJP
+    does implicitly for ZeRO-1; stating it as a custom VJP pins the
+    pairing against AD internals and gives the overlap plan a stable
+    per-leaf anchor in the backward."""
+    fn = _GATHER_CACHE.get(d)
+    if fn is not None:
+        return fn
+
+    @jax.custom_vjp
+    def gather(x):
+        return lax.all_gather(x, DATA_AXIS, axis=d, tiled=True)
+
+    def fwd(x):
+        return lax.all_gather(x, DATA_AXIS, axis=d, tiled=True), None
+
+    def bwd(_, ct):
+        return (lax.psum_scatter(
+            ct, DATA_AXIS, scatter_dimension=d, tiled=True),)
+
+    gather.defvjp(fwd, bwd)
+    _GATHER_CACHE[d] = gather
+    return gather
+
+
+def make_zero3_train_step(mesh: Mesh, state_template, param_specs,
+                          compute_dtype=jnp.float32, lr_schedule=None,
+                          seed: int = 0, accum_steps: int = 1,
+                          label_smoothing: float = 0.0, tx_factory=None,
+                          dcn_dtype: str = "fp32", overlap: bool = False,
+                          bucket_bytes=None):
+    """ZeRO-3/FSDP variant of ``make_zero1_train_step``: same contract
+    (``state`` in the ``shard_zero3_state`` layout, back in it), same
+    collective volume (gather + scatter = DDP's all-reduce bytes), but
+    placement comes from the arch's rules table (``param_specs`` =
+    ``zero3_param_specs``) and the gather/scatter boundary is the
+    explicit ``_zero3_gather`` custom VJP. Composes exactly like
+    ZeRO-1: ``accum_steps`` keeps the fp32 grad accumulator SHARD-sized
+    (the scatter runs per microbatch inside the boundary's backward),
+    a hierarchical mesh adds the shard-sized DCN hop once per update,
+    and ``overlap=True`` buckets the DCN/remainder work in-backward
+    (``make_zero1_bucket_reduce`` — the bucket engine is
+    layout-agnostic, it only needs the sharded flags)."""
+    from dptpu.parallel.hierarchy import (
+        DCN_DTYPES,
+        dcn_reduce_shard,
+        is_hierarchical,
+    )
+    from dptpu.train.step import (
+        shard_map_nocheck,
+        tpu_compiler_options,
+        train_step_body,
+    )
+
+    if dcn_dtype not in DCN_DTYPES:
+        raise ValueError(
+            f"dcn_dtype={dcn_dtype!r} must be one of "
+            + "/".join(repr(d) for d in DCN_DTYPES)
+        )
+    if lr_schedule is None:
+        lr_schedule = lambda count: 0.1  # noqa: E731
+    hier = is_hierarchical(mesh)
+    slices = int(mesh.shape[SLICE_AXIS]) if hier else 1
+    axis_names = data_axis_names(mesh)
+    axis_size = data_parallel_width(mesh)
+    specs = zero3_state_specs(state_template, mesh, param_specs)
+    tx = None
+    if tx_factory is not None:
+        tx = tx_factory(sumsq_reduce=zero1_sumsq_reduce(specs.params))
+    else:
+        from dptpu.ops.optimizers import trust_ratio_stats
+
+        if trust_ratio_stats(state_template.opt_state) is not None:
+            raise ValueError(
+                "state uses a trust-ratio optimizer (LARS/LAMB) but no "
+                "tx_factory was given — the sharded update would "
+                "compute per-layer norms from local shards only. Pass "
+                "tx_factory=partial(make_optimizer, momentum, wd, name) "
+                "so the norm completer can be injected."
+            )
+
+    def gather_params(params):
+        def gather(x, s):
+            d = _sharded_axis(s)
+            if d < 0:
+                return x
+            return _zero3_gather(d)(x)
+
+        return jax.tree_util.tree_map(gather, params, specs.params)
+
+    def reduce_grads(grads):
+        # sharded leaves arrived scatter-reduced over the intra-slice
+        # axis through the custom-VJP boundary; hierarchical meshes add
+        # the shard-sized DCN hop, replicated remainders their explicit
+        # psum — identical composition to the ZeRO-1 step.
+        def red(g, s):
+            if _sharded_axis(s) >= 0:
+                return dcn_reduce_shard(g, SLICE_AXIS, dcn_dtype,
+                                        slices=slices) if hier else g
+            g = lax.psum(g, DATA_AXIS)
+            return lax.psum(g, SLICE_AXIS) if hier else g
+
+        return jax.tree_util.tree_map(red, grads, specs.params)
+
+    overlap_plan = None
+    if overlap:
+        from dptpu.parallel.overlap import (
+            DEFAULT_BUCKET_MB,
+            OverlapPlan,
+            make_zero1_bucket_reduce,
+        )
+
+        sharded_flags = [
+            _sharded_axis(s) >= 0
+            for s in jax.tree_util.tree_leaves(
+                specs.params, is_leaf=lambda x: isinstance(x, P)
+            )
+        ]
+        overlap_plan = OverlapPlan(
+            bucket_bytes or int(DEFAULT_BUCKET_MB * 1e6),
+            make_zero1_bucket_reduce(sharded_flags, hier, dcn_dtype,
+                                     slices=slices),
+        )
+        reduce_grads = None  # the plan carries the whole reduction
+
+    def step(state, batch):
+        return train_step_body(
+            state, batch, compute_dtype=compute_dtype,
+            lr_schedule=lr_schedule, seed=seed, axis_size=axis_size,
+            on_mesh=True, gather_params=gather_params,
+            reduce_grads=reduce_grads, tx=tx, accum_steps=accum_steps,
+            label_smoothing=label_smoothing, axis_names=axis_names,
+            overlap_plan=overlap_plan,
+        )
+
+    batch_spec = P(squeeze_axes(axis_names))
+    sharded = shard_map_nocheck(
+        step,
+        mesh=mesh,
+        in_specs=(specs, batch_spec),
+        out_specs=(specs, P()),
+    )
+    return jax.jit(
+        sharded, donate_argnums=0, compiler_options=tpu_compiler_options()
+    )
+
+
 def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
                           lr_schedule=None, seed: int = 0,
                           accum_steps: int = 1, label_smoothing: float = 0.0,
@@ -281,6 +526,7 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
     if lr_schedule is None:
         lr_schedule = lambda count: 0.1  # noqa: E731
     hier = is_hierarchical(mesh)
+    slices = int(mesh.shape[SLICE_AXIS]) if hier else 1
     axis_names = data_axis_names(mesh)
     # gradient normalizer spans ALL replicas (slices × dp_in_slice);
     # the state specs below shard over the intra-slice axis only
@@ -330,8 +576,8 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
         # sum — under check_rep=False nothing is implicit.
         def red(g, s):
             if _sharded_axis(s) >= 0:
-                return dcn_reduce_shard(g, SLICE_AXIS, dcn_dtype) \
-                    if hier else g
+                return dcn_reduce_shard(g, SLICE_AXIS, dcn_dtype,
+                                        slices=slices) if hier else g
             g = lax.psum(g, DATA_AXIS)
             return lax.psum(g, SLICE_AXIS) if hier else g
 
@@ -353,7 +599,8 @@ def make_zero1_train_step(mesh: Mesh, state_template, compute_dtype=jnp.float32,
         ]
         overlap_plan = OverlapPlan(
             bucket_bytes or int(DEFAULT_BUCKET_MB * 1e6),
-            make_zero1_bucket_reduce(sharded_flags, hier, dcn_dtype),
+            make_zero1_bucket_reduce(sharded_flags, hier, dcn_dtype,
+                                     slices=slices),
         )
         reduce_grads = None  # the plan carries the whole reduction
 

@@ -189,7 +189,7 @@ class CheckpointManager:
     def __init__(self, directory: str = ".", keep: int = 3,
                  is_chief: bool = True, arch: str = "",
                  batch_size: Optional[int] = None, fault_plan=None,
-                 async_writer=None, geometry=None):
+                 async_writer=None, geometry=None, sharding: str = ""):
         if keep < 1:
             raise ValueError(f"ckpt keep={keep} must be >= 1")
         self.directory = directory
@@ -202,6 +202,10 @@ class CheckpointManager:
         # (world_size, global_batch, accum) stamped into every step
         # save so a changed-geometry --resume can name both tuples
         self.geometry = geometry
+        # the run's sharding fingerprint ("<rules-hash>:<placement>" /
+        # "replicated" — fit.py computes it), stamped so a --resume
+        # under a changed sharding config can name both fingerprints
+        self.sharding = sharding
 
     def save_step(self, state, *, epoch: int, step_in_epoch: int,
                   best_acc1: float = 0.0, sync: bool = False
@@ -260,6 +264,7 @@ class CheckpointManager:
                         if self.batch_size is not None else None
                     ),
                     geometry=self.geometry,
+                    sharding=self.sharding,
                 )
                 if self.fault_plan is not None and not remote:
                     # fault hooks (ckpt_truncate@save=N) count ACTUAL

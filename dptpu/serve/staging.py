@@ -105,9 +105,16 @@ class StagingRing:
             (slots, bucket_max) + self.item_shape, np.uint8,
             buffer=self._shm.buf,
         )
-        self._state = [_FREE] * slots
-        self._gen = [0] * slots
-        self._closed = False
+        # Generation-fenced slot state machine (CONCURRENCY.md): forward
+        # transitions (FREE->FILLING->LEASED) run on the batcher
+        # dispatcher thread only; the backward LEASED->FREE transition
+        # runs on whichever thread releases the lease, fenced by the
+        # per-slot generation counter so a late release against a
+        # recycled slot is a no-op. Every write is one GIL-atomic list
+        # element store — the protocol, not a lock, is the owner.
+        self._state = [_FREE] * slots  # owned-by: slot-protocol
+        self._gen = [0] * slots  # owned-by: slot-protocol
+        self._closed = False  # owned-by: slot-protocol
         _register(self)
 
     def acquire(self) -> Optional[int]:

@@ -186,6 +186,7 @@ def save_checkpoint(
     step_in_epoch: int = 0,
     data_position: Optional[int] = None,
     geometry: Optional[tuple] = None,
+    sharding: str = "",
 ) -> Optional[str]:
     """Serialize state; copy to model_best when ``is_best``. Chief-only.
 
@@ -199,6 +200,16 @@ def save_checkpoint(
     batch geometry fail fast naming both the saved and current tuples
     (the groundwork for elastic resume, ROADMAP item 3b: a remapper
     needs exactly these coordinates) instead of a bare mismatch.
+
+    ``sharding`` is the run's sharding fingerprint —
+    ``"<rules-table-hash>:zero<stage>"`` for the rules-driven sharded
+    families (dptpu/parallel/rules.py), ``"replicated"`` for the
+    replicated steps, ``""`` for contexts with no placement to stamp.
+    A mid-epoch ``--resume`` under a CHANGED sharding fails fast naming
+    both fingerprints (fit.py) unless DPTPU_ELASTIC opts into
+    re-sharding; epoch-boundary resumes re-shard freely (checkpoints
+    always hold the gathered full-leaf state, so the stamp is
+    provenance, not a storage format).
     """
     if not is_chief:
         return None
@@ -224,6 +235,7 @@ def save_checkpoint(
         "world_size": geom[0],
         "global_batch": geom[1],
         "accum_steps": geom[2],
+        "sharding": str(sharding),
     }
     # EVERY checkpoint write goes through the Store abstraction
     # (dptpu/data/store.py): a plain directory routes to LocalStore —
@@ -296,6 +308,7 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "world_size": -1,
         "global_batch": -1,
         "accum_steps": -1,
+        "sharding": "",
     }
     # Optional bookkeeping fields, defaulted when absent so every older
     # payload generation parses: pre-round-4 files lack qkv_layout (and
@@ -303,7 +316,7 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
     # lack the mid-epoch resume coordinates, pre-hierarchy files lack
     # the (world_size, global_batch, accum) geometry tuple.
     _OPTIONAL = ("qkv_layout", "step_in_epoch", "data_position",
-                 "world_size", "global_batch", "accum_steps")
+                 "world_size", "global_batch", "accum_steps", "sharding")
     # structural legacy detection, single decode: restore the msgpack
     # tree once (raises its precise error on a corrupt file), pick the
     # template by the payload's own top-level keys, and validate with
@@ -350,6 +363,9 @@ def load_checkpoint(path: str, state, arch: Optional[str] = None,
         "geometry": (int(payload["world_size"]),
                      int(payload["global_batch"]),
                      int(payload["accum_steps"])),
+        # sharding fingerprint at save time; "" for files from before
+        # the rules engine (resume then skips the sharding cross-check)
+        "sharding": str(payload["sharding"]),
     }
     return new_state, meta
 
@@ -469,5 +485,6 @@ def _load_torch_checkpoint(path: str, state, arch: Optional[str],
         "step_in_epoch": 0,
         "data_position": -1,
         "geometry": (-1, -1, -1),
+        "sharding": "",
     }
     return new_state, meta

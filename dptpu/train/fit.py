@@ -463,18 +463,18 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # parallelism (dptpu/parallel/hierarchy.py) — the gradient
     # all-reduce decomposes into reduce-scatter(ICI) + shard-sized
     # all-reduce(DCN) + all-gather(ICI). Composes with the default DDP
-    # step AND with DPTPU_ZERO1 (state shards over the intra-slice
-    # axis, so the weight all-gather stays on ICI); TP/SP/GSPMD keep
-    # their own single-level topologies (explicit requests win, with a
-    # notice — the repo-wide precedence discipline).
+    # step, with DPTPU_ZERO1/DPTPU_ZERO=3 (state shards over the
+    # intra-slice axis, so the weight all-gather stays on ICI), AND
+    # with DPTPU_GSPMD (the {slice, data}-factored mesh + rules-table
+    # FSDP placement make the partitioner derive its own DCN-aware
+    # decomposition); TP/SP keep their own single-level topologies
+    # (explicit requests win, with a notice — the repo-wide precedence
+    # discipline).
     want_hier = slices > 1
     want_gspmd_early = _os_environ_flag("DPTPU_GSPMD")
     use_hier = (
         want_hier and not single_device and not cfg.evaluate
-        and not use_tp and not use_sp and not want_gspmd_early
-        # a demoted TP request routes to the GSPMD dp_specs step, which
-        # derives its own collectives on a flat mesh
-        and not tp_fallback
+        and not use_tp and not use_sp
     )
     if slices == 1 and _os_environ_int("DPTPU_SLICES") == 1 and verbose:
         print("=> DPTPU_SLICES=1 is a no-op: one slice is the flat "
@@ -485,10 +485,6 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if use_tp
             else "DPTPU_SP drives the sequence-parallel step"
             if use_sp
-            else "DPTPU_GSPMD derives its own single-program collectives "
-                 "(hierarchical placement there is a follow-on)"
-            if (want_gspmd_early or tp_fallback)
-            and not single_device and not cfg.evaluate
             else "--evaluate does not train"
             if cfg.evaluate and not single_device
             else "single-device run (no DCN hop to factor)"
@@ -530,12 +526,22 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         if verbose:
             import jax as _jax
 
-            print(
-                f"=> hierarchical data parallelism: {slices} slices x "
-                f"{_jax.device_count() // slices} chips/slice — gradient "
-                f"reduction is reduce-scatter(ICI) + shard-sized "
-                f"all-reduce(DCN, {dcn_dtype}) + all-gather(ICI)"
-            )
+            if want_gspmd_early or tp_fallback:
+                print(
+                    f"=> hierarchical data parallelism: {slices} slices "
+                    f"x {_jax.device_count() // slices} chips/slice — "
+                    f"the SPMD partitioner derives the per-link "
+                    f"decomposition from the {{slice, data}}-factored "
+                    f"mesh + rules-table FSDP placement"
+                )
+            else:
+                print(
+                    f"=> hierarchical data parallelism: {slices} slices x "
+                    f"{_jax.device_count() // slices} chips/slice — "
+                    f"gradient reduction is reduce-scatter(ICI) + "
+                    f"shard-sized all-reduce(DCN, {dcn_dtype}) + "
+                    f"all-gather(ICI)"
+                )
     else:
         mesh = make_mesh()
     if cfg.multiprocessing_distributed and verbose:
@@ -736,15 +742,45 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     # one logical program, so BN statistics are ALWAYS global (SyncBN
     # behavior) and the model must not carry a shard-local axis name.
     want_gspmd = _os_environ_flag("DPTPU_GSPMD")
-    want_zero1 = _os_environ_flag("DPTPU_ZERO1")  # read once; the ZeRO-1
-    # block below reuses this so the precedence rule has one source.
+    # DPTPU_ZERO selects the ZeRO stage by number: 1 is the shipped
+    # weight-update sharding (same as DPTPU_ZERO1=1), 3 the full
+    # param+grad+optimizer sharding driven by the arch's partition
+    # rules table (dptpu/parallel/rules.py); DPTPU_FSDP=1 is the
+    # synonym the FSDP literature spells stage 3 with. Read once; the
+    # step-selection blocks below reuse these so the precedence rule
+    # has one source.
+    _zero_stage = _os_environ_int("DPTPU_ZERO")
+    if _zero_stage not in (None, 0, 1, 3):
+        raise ValueError(
+            f"DPTPU_ZERO={_zero_stage} is not a supported stage — use 1 "
+            f"(weight-update sharding, the DPTPU_ZERO1=1 alias), 3 "
+            f"(param+grad+optimizer sharding, the DPTPU_FSDP=1 alias), "
+            f"or 0/unset for replicated data parallelism"
+        )
+    want_zero3 = _zero_stage == 3 or _os_environ_flag("DPTPU_FSDP")
+    want_zero1 = _os_environ_flag("DPTPU_ZERO1") or _zero_stage == 1
     # Precedence: DPTPU_TP (an explicit topology request — the mesh was
-    # already factored for it) > DPTPU_SP > DPTPU_ZERO1 > DPTPU_GSPMD.
+    # already factored for it) > DPTPU_SP > DPTPU_ZERO=3 > DPTPU_ZERO1
+    # > DPTPU_GSPMD.
+    use_zero3 = (
+        want_zero3 and mesh is not None and not cfg.evaluate
+        and not use_tp and not use_sp
+    )
     use_zero1 = (
         want_zero1 and mesh is not None and not cfg.evaluate and not use_tp
-        and not use_sp
+        and not use_sp and not use_zero3
     )
-    if want_zero1 and use_tp and verbose:
+    if want_zero3 and use_tp and verbose:
+        print("=> DPTPU_ZERO=3/DPTPU_FSDP ignored: DPTPU_TP drives the "
+              "GSPMD tensor-parallel step (params shard over the model "
+              "axis per the same rules table)")
+    elif want_zero3 and use_sp and verbose:
+        print("=> DPTPU_ZERO=3/DPTPU_FSDP ignored: DPTPU_SP drives the "
+              "sequence-parallel step")
+    if want_zero1 and use_zero3 and verbose:
+        print("=> DPTPU_ZERO1 noted: DPTPU_ZERO=3 supersedes it (stage "
+              "3 shards everything stage 1 shards, plus the params)")
+    elif want_zero1 and use_tp and verbose:
         print("=> DPTPU_ZERO1 ignored: DPTPU_TP drives the GSPMD "
               "tensor-parallel step (params shard over the model axis, "
               "not the optimizer state over data)")
@@ -754,15 +790,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     use_gspmd = (
         (want_gspmd or use_tp or tp_fallback)
         and mesh is not None and not cfg.evaluate
-        and not use_zero1 and not use_sp
+        and not use_zero3 and not use_zero1 and not use_sp
     )
     if want_gspmd and use_sp and verbose:
         print("=> DPTPU_GSPMD ignored: DPTPU_SP drives the "
               "sequence-parallel step")
     if want_gspmd and not use_gspmd and not use_sp and verbose:
-        # name ZeRO-1 as the reason only when ZeRO-1 will actually run
+        # name a ZeRO stage as the reason only when it will actually run
         why = (
-            "DPTPU_ZERO1 takes precedence"
+            "DPTPU_ZERO=3 takes precedence"
+            if use_zero3
+            else "DPTPU_ZERO1 takes precedence"
             if use_zero1
             else "--evaluate does not train"
             if cfg.evaluate
@@ -774,12 +812,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
               "always sees the global batch in the single-program step")
     # Bucketed backward-overlapped gradient comms (DPTPU_OVERLAP=1,
     # dptpu/parallel/overlap.py): composes with the shard_map step
-    # families (DDP, ZeRO-1, --slices, --accum-steps); TP/SP/GSPMD
-    # derive or place their own collectives, and a mesh-less
+    # families (DDP, ZeRO-1/3, --slices, --accum-steps) AND the plain
+    # GSPMD path (per-bucket sharding-constraint boundaries — the
+    # partitioner already interleaves per-leaf reductions, so the
+    # buckets bound its regrouping freedom rather than create overlap
+    # from nothing); TP/SP place their own collectives, and a mesh-less
     # single-device step has none to overlap.
     use_overlap = (
         want_overlap and mesh is not None and not cfg.evaluate
-        and not use_tp and not use_sp and not use_gspmd
+        and not use_tp and not use_sp
     )
     if want_overlap and not use_overlap and verbose:
         why = (
@@ -787,10 +828,6 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             if use_tp
             else "DPTPU_SP drives the sequence-parallel step"
             if use_sp
-            else "DPTPU_GSPMD derives its own collectives (the "
-                 "partitioner schedules them; bucketing there is a "
-                 "follow-on)"
-            if use_gspmd
             else "--evaluate does not train"
             if cfg.evaluate and mesh is not None
             else "single-device run (no gradient collective to overlap)"
@@ -806,6 +843,30 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             f"collective issued inside backward (bit-identical to the "
             f"unbucketed step)"
         )
+    # The sharding fingerprint this run stamps into checkpoints:
+    # "<rules-table-hash>:<placement>" for the sharded placements (the
+    # hash pins the TABLE the placement came from, so editing a
+    # family's rules reads as a sharding change on resume), plain
+    # "replicated" for the replicated-param steps. The mid-epoch
+    # --resume cross-check below fail-fasts on a mismatch naming both
+    # fingerprints unless DPTPU_ELASTIC opts into re-sharding.
+    from dptpu.models.registry import (
+        GENERIC_RULES,
+        partition_rules_for_arch,
+    )
+    from dptpu.parallel.rules import rules_fingerprint
+
+    _arch_fp = rules_fingerprint(partition_rules_for_arch(cfg.arch))
+    sharding_tag = (
+        f"{_arch_fp}:zero3" if use_zero3
+        # ZeRO-1 places per-leaf over data via the GENERIC table's
+        # AUTO_FSDP row — its fingerprint must not move when a
+        # family's TP rules are edited
+        else f"{rules_fingerprint(GENERIC_RULES)}:zero1" if use_zero1
+        else f"{_arch_fp}:tp{tp_n}" if use_tp
+        else f"{_arch_fp}:fsdp" if (use_gspmd and use_hier)
+        else "replicated"
+    )
     # ramp x parallel-topology composition: the ramp rebuilds the
     # loader + step per phase, which only the shard_map families
     # support — fail fast naming the knobs and both alternatives
@@ -995,6 +1056,37 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                         f"epoch replays exactly; the LR is rescaled "
                         f"per the linear-scaling rule)."
                     )
+                # sharding fingerprint cross-check (ISSUE 16): the
+                # checkpoint always holds gathered full leaves, so ANY
+                # placement can load it — but a mid-epoch replay under
+                # a silently-changed sharding config (a different ZeRO
+                # stage, an edited rules table) is a config drift the
+                # operator should confirm, not discover post-hoc in a
+                # diverged curve. DPTPU_ELASTIC is the confirmation:
+                # the full-leaf state simply re-shards onto the new
+                # placement (shard_zero3_state et al. device_put). ""
+                # means a pre-rules file — no stamp, no check.
+                saved_sharding = str(meta.get("sharding", ""))
+                if resume_step and saved_sharding \
+                        and saved_sharding != sharding_tag \
+                        and not el_conf["elastic"]:
+                    raise ValueError(
+                        f"'{resolved}' was saved mid-epoch (step "
+                        f"{resume_step}) under sharding "
+                        f"'{saved_sharding}' but this run places as "
+                        f"'{sharding_tag}' — the sharding config (ZeRO "
+                        f"stage, TP rule, or the partition-rules table "
+                        f"itself) changed. Resume with the saved "
+                        f"config, pass --start-epoch to restart from "
+                        f"an epoch boundary, or set DPTPU_ELASTIC=1 to "
+                        f"re-shard the full-leaf checkpoint onto the "
+                        f"new placement."
+                    )
+                if saved_sharding and saved_sharding != sharding_tag \
+                        and el_conf["elastic"] and verbose:
+                    print(f"=> elastic re-shard: checkpoint sharding "
+                          f"'{saved_sharding}' -> '{sharding_tag}' "
+                          f"(full-leaf state re-places on load)")
                 if resume_step and saved_geom[0] >= 0 \
                         and saved_geom != expect_geom:
                     # the elastic shrink/grow remap (ROADMAP item 3a)
@@ -1104,17 +1196,75 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         steps_per_epoch = max(len(train_loader), 1)
         schedule = _phase_schedule(ramp_mult, start_epoch)
 
-    # want_zero1/use_zero1 were computed once, before model build (the
+    # want_zero*/use_zero* were computed once, before model build (the
     # GSPMD-precedence block) — reused here so the rule cannot desync.
     # --evaluate never trains: sharding the state only to re-gather it
     # for validation would be two pointless full-state device_put rounds.
+    if want_zero3 and mesh is None and verbose:
+        print("=> DPTPU_ZERO=3/DPTPU_FSDP ignored: single-device run "
+              "(no mesh to shard the params over)")
+    elif want_zero3 and cfg.evaluate and verbose:
+        print("=> DPTPU_ZERO=3/DPTPU_FSDP ignored: --evaluate does not "
+              "train")
     if want_zero1 and mesh is None and verbose:
         print("=> DPTPU_ZERO1 ignored: single-device run (no mesh to "
               "shard the optimizer state over)")
-    elif want_zero1 and cfg.evaluate and verbose:
+    elif want_zero1 and cfg.evaluate and not want_zero3 and verbose:
         print("=> DPTPU_ZERO1 ignored: --evaluate does not train")
     opt_shard_bytes = None
-    if use_zero1:
+    if use_zero3:
+        # ZeRO-3/FSDP: params, gradients AND optimizer state live
+        # sharded over the (intra-slice) data axis — placement comes
+        # from the arch's partition-rules table projected onto the
+        # data axis (dptpu/parallel/rules.py), the forward/backward
+        # all-gather-on-use boundary is the _zero3_gather custom VJP
+        # (its backward IS the reduce-scatter), and the entire update
+        # runs on the local shard exactly like ZeRO-1. Same collective
+        # volume as DDP (gather + scatter = the all-reduce bytes), so
+        # the win is memory: ~1/N persistent bytes per chip for the
+        # whole params+opt-state footprint (tests/test_zero1.py locks
+        # parity and the byte ratio; SCALEBENCH reports it).
+        from dptpu.parallel import (
+            make_zero3_train_step,
+            shard_zero3_state,
+            state_shard_bytes,
+            zero3_param_specs,
+            zero3_state_specs,
+        )
+
+        z3_param_specs = zero3_param_specs(cfg.arch, state.params, mesh)
+
+        def _build_train_step(sched):
+            # `state` binds late: a ramp-phase rebuild mid-run passes
+            # the LIVE sharded state as the template (same structure)
+            return make_zero3_train_step(
+                mesh, state, z3_param_specs, compute_dtype,
+                lr_schedule=sched,
+                seed=cfg.seed if cfg.seed is not None else 0,
+                accum_steps=accum_steps, label_smoothing=label_smooth,
+                tx_factory=partial(
+                    make_optimizer, cfg.momentum, cfg.weight_decay,
+                    opt_name
+                ),
+                dcn_dtype=dcn_dtype if use_hier else "fp32",
+                overlap=use_overlap, bucket_bytes=bucket_bytes,
+            )
+
+        train_step = _build_train_step(schedule)
+        opt_shard_bytes = state_shard_bytes(
+            state, mesh, zero3_state_specs(state, mesh, z3_param_specs)
+        )
+        state = shard_zero3_state(state, mesh, z3_param_specs)
+        # one all-gather per validation pass / checkpoint write (the
+        # ZeRO-1 discipline) — sharded leaves are global jax.Arrays,
+        # so the gather is transparent to eval and the writer
+        eval_view = lambda s: gather_state(s, mesh)  # noqa: E731
+        eval_view_gathers = True  # collective: every host must join
+        if verbose:
+            print("=> ZeRO-3 param+grad+optimizer sharding over the "
+                  f"data axis (rules table; persistent state "
+                  f"{opt_shard_bytes / 1e6:.1f} MB/chip)")
+    elif use_zero1:
         # ZeRO-1 weight-update sharding: params + optimizer state live
         # sharded over the data axis (~1/N persistent memory per chip),
         # gradients arrive reduce-scattered through the all-gather VJP,
@@ -1155,10 +1305,15 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # single-program GSPMD/pjit path: shardings annotated on jit, the
         # partitioner derives every collective (gradient all-reduce over
         # data; under TP, one all-reduce per MLP/attention block over
-        # model). Batch stays P("data") — the layout shard_host_batch
-        # already produces — so loaders are unchanged.
+        # model). Batch stays batch-dim-sharded over the data axes — the
+        # layout shard_host_batch already produces — so loaders are
+        # unchanged. On a hierarchical mesh (--slices > 1) params take
+        # the rules-table FSDP placement over the intra-slice axis, so
+        # the partitioner's decomposition is DCN-aware (the per-link
+        # budget gspmd_hier in HLO_BUDGETS.json locks the shape).
         from dptpu.parallel.gspmd import (
             dp_specs,
+            gspmd_specs_for_arch,
             make_gspmd_train_step,
             shard_gspmd_state,
             tp_specs_for_arch,
@@ -1173,6 +1328,21 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     f"=> tensor parallelism: {rule} over model axis of "
                     f"{tp_n} × data axis of {int(mesh.shape['data'])}"
                 )
+        elif use_hier:
+            rule = "gspmd_fsdp"
+            specs = gspmd_specs_for_arch(
+                cfg.arch, state.params, mesh, fsdp=True
+            )
+            if verbose:
+                print("=> GSPMD hierarchical data parallelism: "
+                      "rules-table FSDP placement over the intra-slice "
+                      "axis; the partitioner derives the per-link "
+                      "collective decomposition")
+            if dcn_dtype != "fp32":
+                print(f"=> DPTPU_DCN_DTYPE={dcn_dtype} ignored: the "
+                      f"GSPMD partitioner schedules its own DCN "
+                      f"collectives (the compressed hop is "
+                      f"shard_map-only)")
         else:
             rule, specs = "dp_specs", dp_specs(state.params)
             if verbose:
@@ -1181,6 +1351,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
             mesh, state, specs, compute_dtype, lr_schedule=schedule,
             seed=cfg.seed if cfg.seed is not None else 0,
             accum_steps=accum_steps, label_smoothing=label_smooth,
+            overlap=use_overlap, bucket_bytes=bucket_bytes,
         )
         state = shard_gspmd_state(state, mesh, specs)
         if rule == "dp_specs":
@@ -1353,6 +1524,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         # exactly; the loop-top phase switcher keeps this current
         geometry=(run_geom[0], run_geom[1] * ramp_mult, run_geom[2])
         if batch_ramp is not None else run_geom,
+        sharding=sharding_tag,
     )
     guard = PreemptionGuard()
     # quorum coordination (dptpu/resilience/quorum.py): when a
@@ -1730,6 +1902,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     is_chief=derived.is_chief,
                     directory=ckpt_dir,
                     geometry=manager.geometry,
+                    sharding=sharding_tag,
                 )
             if fault_plan is not None and boundary_path:
                 # boundary saves count toward ckpt_truncate@save=N too —
@@ -1852,6 +2025,7 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                     training_time=training_time,
                     directory=ckpt_dir,
                     geometry=manager.geometry,
+                    sharding=sharding_tag,
                 )
                 if fault_plan is not None and early_path:
                     from dptpu.data.store import is_store_url as _is_url

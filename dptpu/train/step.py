@@ -43,6 +43,7 @@ from dptpu.parallel.hierarchy import (
 )
 from dptpu.parallel.mesh import (
     DATA_AXIS,
+    SLICE_AXIS,
     data_axis_names,
     data_parallel_width,
     squeeze_axes,
@@ -386,9 +387,11 @@ def make_train_step(mesh: Optional[Mesh] = None, compute_dtype=jnp.float32,
         )
 
         inner = int(mesh.shape[DATA_AXIS]) if hier else None
+        n_slices = int(mesh.shape[SLICE_AXIS]) if hier else None
         overlap_plan = OverlapPlan(
             bucket_bytes or int(DEFAULT_BUCKET_MB * 1e6),
-            make_ddp_bucket_reduce(hier, dcn_dtype, inner=inner),
+            make_ddp_bucket_reduce(hier, dcn_dtype, inner=inner,
+                                   slices=n_slices),
         )
     elif hier:
         # the two-level reduction: per-chip DCN bytes ~1/dp_in_slice of

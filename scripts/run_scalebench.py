@@ -22,6 +22,12 @@ dptpu/parallel/zero.py) made measurable per DP width N:
    reduce-scatter / all-reduce instruction, vs the DDP step's psum
    volume. This is the compiled program's own accounting, not an
    analytic formula.
+4. **ZeRO-3 state bytes/chip ~ 1/N** (ISSUE 16) — ``state_shard_bytes``
+   under the rules-table placement (``zero3_param_specs``): the RESIDENT
+   params+momentum one chip holds between steps, vs the replicated
+   total, plus the ZeRO-3 step's own HLO collective accounting next to
+   the zero1/ddp rows (gather + scatter ≈ DDP's all-reduce bytes — the
+   ZeRO-3 claim is memory 1/N at flat-equal comm volume).
 
 Plus the **scaling-efficiency curve** (img/s/chip vs DP width, accum
 on/off) through the full DDP train step on the virtual mesh — recorded
@@ -97,7 +103,15 @@ def main():
         shard_zero1_state,
         zero1_update_shard_bytes,
     )
-    from dptpu.parallel.zero import _leaf_spec, _sharded_axis
+    from dptpu.parallel.zero import (
+        _leaf_spec,
+        _sharded_axis,
+        make_zero3_train_step,
+        shard_zero3_state,
+        state_shard_bytes,
+        zero3_param_specs,
+        zero3_state_specs,
+    )
     from dptpu.train import create_train_state, make_optimizer, make_train_step
 
     model = create_model(args.arch, num_classes=16)
@@ -155,11 +169,18 @@ def main():
         # 1. bytes/chip (exact)
         if n == 1:
             row["update_shard_bytes"] = int(total_bytes)
+            row["zero3_state_shard_bytes"] = int(total_bytes)
         else:
             mesh_n = make_mesh(jax.devices()[:n], {"data": n})
             row["update_shard_bytes"] = int(
                 zero1_update_shard_bytes(state, mesh_n)
             )
+            # resident params+momentum under the rules-table ZeRO-3
+            # placement — the memory half of the ZeRO-3 claim
+            p_specs = zero3_param_specs(args.arch, state.params, mesh_n)
+            row["zero3_state_shard_bytes"] = int(state_shard_bytes(
+                state, mesh_n, zero3_state_specs(state, mesh_n, p_specs)
+            ))
 
         # 2. optimizer update time/chip: LARS update jitted alone over
         # shard-sized leaves on one device (norm completion is a no-op
@@ -224,6 +245,21 @@ def main():
             row["ddp_collective_bytes_per_chip"] = (
                 _collective_bytes_per_chip(d_hlo, n)
             )
+            st3 = create_train_state(
+                jax.random.PRNGKey(0), model, base_tx,
+                input_shape=(1, args.image, args.image, 3),
+            )
+            p_specs3 = zero3_param_specs(args.arch, st3.params, mesh_n)
+            z3_step = make_zero3_train_step(
+                mesh_n, st3, p_specs3,
+                tx_factory=partial(make_optimizer, 0.9, 1e-4, "lars"),
+            )
+            z3_hlo = z3_step.lower(
+                shard_zero3_state(st3, mesh_n, p_specs3), sbatch
+            ).compile().as_text()
+            row["zero3_collective_bytes_per_chip"] = (
+                _collective_bytes_per_chip(z3_hlo, n)
+            )
 
             # 4. throughput curve, accum off/on (virtual mesh — see
             # host_caveat)
@@ -263,6 +299,10 @@ def main():
     if w1:
         report["update_bytes_ratio_maxwidth_vs_1"] = round(
             wmax["update_shard_bytes"] / w1["update_shard_bytes"], 4
+        )
+        report["zero3_state_bytes_ratio_maxwidth_vs_1"] = round(
+            wmax["zero3_state_shard_bytes"]
+            / w1["zero3_state_shard_bytes"], 4
         )
         report["update_time_ratio_maxwidth_vs_1"] = round(
             wmax["update_time_ms_per_chip"]

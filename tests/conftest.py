@@ -93,6 +93,11 @@ _FAST_MODULES = {
     # sub-second thread exercises — the ABBA and unguarded-shared-write
     # acceptance bars MUST hold in tier 1
     "test_concurrency",
+    # unified partition rules (ISSUE 16): the matcher/projection units
+    # and the dptpu-check partition-rules gate are eval_shape-only (no
+    # weights allocated, no step compiles) — the one-table-many-views
+    # equivalence locks MUST hold in tier 1
+    "test_rules",
     # overlapped gradient comms (ISSUE 13): partitioner/evidence units
     # are pure; the parity ladder compiles TinyDense-sized shard_map
     # steps (the test_hierarchy precedent) and holds the acceptance

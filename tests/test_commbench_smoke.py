@@ -60,3 +60,21 @@ def test_commbench_smoke_gates(tmp_path):
     assert abs(bench["overlap_dcn_vs_hier_ratio"] - 1.0) <= 0.02
     assert bench["overlap_evidence"]["reductions"] >= 2
     assert bench["overlap_evidence"]["interleaved_gaps"] >= 1
+    # the GSPMD-path arms (ISSUE 16): the same rules table drives the
+    # compiler-placed hierarchy, and the annotation-only overlap claim
+    # is byte-exact
+    for key in ("gspmd_flat_per_chip", "gspmd_hier_by_link",
+                "gspmd_overlap_per_chip", "gspmd_overlap_evidence"):
+        assert key in bench, key
+    assert gates["gspmd_hier_ok"], bench["gspmd_hier_by_link"]
+    assert gates["gspmd_overlap_ok"], bench["gspmd_overlap_evidence"]
+    # hierarchy: GSPMD emits AG+AR mixes rather than the shard_map
+    # RS/AR/AG ladder, so the gate is DCN-byte reduction, not shape
+    gh = bench["gspmd_hier_by_link"]
+    assert gh["dcn"]["total"] * 2 < bench["gspmd_flat_per_chip"]["total"]
+    assert gh["ici"]["total"] > gh["dcn"]["total"]
+    # overlap: bucketing annotations change the schedule, never a byte
+    assert bench["parity"]["gspmd_overlap_vs_flat_max_delta"] == 0.0
+    assert bench["gspmd_overlap_per_chip"] == bench["gspmd_flat_per_chip"]
+    assert bench["gspmd_overlap_evidence"]["reductions"] >= 2
+    assert bench["gspmd_overlap_evidence"]["interleaved_gaps"] >= 1

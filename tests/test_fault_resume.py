@@ -376,3 +376,52 @@ def test_batch_ramp_resume_wrong_ramp_fails_actionably(
     monkeypatch.setenv("DPTPU_BATCH_RAMP", "1:4")
     with pytest.raises(ValueError, match="DPTPU_BATCH_RAMP"):
         fit(_cfg(resume=str(d), **cfg_kw), image_size=32, verbose=False)
+
+
+def test_sharding_fingerprint_mismatch_fails_then_elastic_reshards(
+        tmp_path_factory, monkeypatch):
+    """ISSUE 16: checkpoints are stamped with the sharding fingerprint
+    (rules-table hash + placement). A MID-EPOCH resume whose run places
+    differently must fail fast naming BOTH stamps — the replay contract
+    cannot promise bit-identity across a placement change — and
+    ``DPTPU_ELASTIC=1`` opts into the explicit re-shard (checkpoints
+    hold gathered full leaves, so the load itself is placement-free).
+    Pod-path run (one extra resnet18@32 ZeRO-3 compile — the module's
+    second deliberate compile, carrying the ISSUE acceptance bar)."""
+    d = tmp_path_factory.mktemp("shard_fp")
+    monkeypatch.chdir(d)
+    monkeypatch.setenv("DPTPU_ZERO", "3")
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=2")
+    r1 = fit(_cfg(gpu=None, workers=0), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    monkeypatch.delenv("DPTPU_FAULT")
+    monkeypatch.delenv("DPTPU_ZERO")
+    # resume as plain DDP: mid-epoch + changed placement -> fail-fast
+    # naming both the saved and the current sharding tag
+    with pytest.raises(ValueError) as exc:
+        fit(_cfg(gpu=None, workers=0, resume=str(d)), image_size=32,
+            verbose=False)
+    msg = str(exc.value)
+    assert "zero3" in msg and "replicated" in msg
+    # the waiver: elastic re-shard resumes and completes
+    monkeypatch.setenv("DPTPU_ELASTIC", "1")
+    r2 = fit(_cfg(gpu=None, workers=0, resume=str(d)), image_size=32,
+             verbose=False)
+    assert r2["epochs_run"] == 2
+
+
+def test_sharding_fingerprint_same_placement_resumes_unwaivered(
+        tmp_path_factory, monkeypatch):
+    """Control for the fingerprint gate: resuming under the SAME
+    sharding needs no DPTPU_ELASTIC waiver (reuses the ZeRO-3 pod
+    compile from the mismatch test, in-process jit cache)."""
+    d = tmp_path_factory.mktemp("shard_fp_same")
+    monkeypatch.chdir(d)
+    monkeypatch.setenv("DPTPU_ZERO", "3")
+    monkeypatch.setenv("DPTPU_FAULT", "sigterm@step=2")
+    r1 = fit(_cfg(gpu=None, workers=0), image_size=32, verbose=False)
+    assert r1["preempted"] is True
+    monkeypatch.delenv("DPTPU_FAULT")
+    r2 = fit(_cfg(gpu=None, workers=0, resume=str(d)), image_size=32,
+             verbose=False)
+    assert r2["epochs_run"] == 2

@@ -1,0 +1,312 @@
+"""Unified partition-rules engine units (dptpu/parallel/rules.py +
+dptpu/analysis/partition.py): the ordered regex → PartitionSpec matcher,
+its consumer-side projections (pure TP, ZeRO-3/FSDP), the table
+fingerprints the checkpoint sharding stamp carries, and the ``dptpu
+check`` partition-rules gate.
+
+The TP-equivalence locks here deliberately RE-STATE the expected specs
+by hand: ``vit_tp_specs`` et al. are now projections of the same tables,
+so comparing them against ``match_partition_rules`` would be circular —
+the hand-written expectations are the independent truth (same style as
+tests/test_gspmd.py's vit locks, extended to swin v2 and convnext)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dptpu.models import create_model
+from dptpu.models.registry import (
+    CONVNEXT_RULES,
+    FAMILY_RULES,
+    GENERIC_RULES,
+    SWIN_RULES,
+    VIT_RULES,
+    partition_family,
+    partition_rules_for_arch,
+)
+from dptpu.parallel.rules import (
+    AUTO_FSDP,
+    clamp_spec,
+    fsdp_auto_spec,
+    match_partition_rules,
+    project_spec,
+    rule_match_counts,
+    rules_fingerprint,
+    validate_rules,
+)
+
+
+def _shaped_params(arch, px=64):
+    """Shape-only param tree (nothing allocated) — matching and
+    projection need paths and shapes, not values."""
+    model = create_model(arch, num_classes=8)
+    shaped = jax.eval_shape(
+        lambda r, x: model.init(r, x, train=False),
+        jax.random.PRNGKey(0), jnp.zeros((1, px, px, 3), jnp.float32),
+    )
+    return shaped["params"]
+
+
+# ---------------------------------------------------------------- matcher
+
+
+def test_validate_rules_rejects_malformed_tables():
+    with pytest.raises(ValueError, match="empty"):
+        validate_rules(())
+    with pytest.raises(ValueError, match="fallback"):
+        validate_rules(((r"kernel$", P("model")),))  # no trailing .*
+    with pytest.raises(ValueError, match="does not compile"):
+        validate_rules(((r"(unclosed", P()), (r".*", AUTO_FSDP)))
+    with pytest.raises(ValueError, match="PartitionSpec or AUTO_FSDP"):
+        validate_rules(((r".*", "data"),))
+
+
+def test_first_match_wins_in_declaration_order():
+    params = {"block": {"kernel": jnp.zeros((4, 4))}}
+    rules = (
+        (r"kernel$", P("model")),
+        (r"block/kernel$", P("data")),  # also matches, but comes later
+        (r".*", AUTO_FSDP),
+    )
+    specs = match_partition_rules(rules, params)
+    assert specs["block"]["kernel"] == P("model")
+    # and the census sees the same claim order
+    assert rule_match_counts(rules, params) == [1, 0, 0]
+
+
+def test_anchored_segments_do_not_claim_suffix_modules():
+    # the (^|/) anchor: a rule for `proj` must not claim `out_proj`
+    params = {
+        "proj": {"kernel": jnp.zeros((4, 4))},
+        "out_proj": {"kernel": jnp.zeros((4, 4))},
+    }
+    rules = ((r"(^|/)proj/kernel$", P("model", None)), (r".*", AUTO_FSDP))
+    specs = match_partition_rules(rules, params)
+    assert specs["proj"]["kernel"] == P("model", None)
+    assert specs["out_proj"]["kernel"] != P("model", None)
+
+
+def test_strict_dead_raises_and_census_counts():
+    params = {"mlp": {"kernel": jnp.zeros((8, 8))}}
+    rules = (
+        (r"(^|/)nonexistent/kernel$", P("data", "model")),
+        (r".*", AUTO_FSDP),
+    )
+    assert rule_match_counts(rules, params) == [0, 1]
+    with pytest.raises(ValueError, match="dead partition rule"):
+        match_partition_rules(rules, params, strict_dead=True)
+    # the .* fallback itself is exempt from strictness
+    match_partition_rules(GENERIC_RULES, params, strict_dead=True)
+
+
+# ------------------------------------------------------------ projections
+
+
+def test_tp_projection_grammar_truth_table():
+    """The grammar's pure-TP projections (keep only ``model``) — the
+    exact equivalences the registry tables rely on."""
+    keep = ("model",)
+    assert project_spec(P("data", "model"), keep) == P(None, "model")
+    assert project_spec(P(("data", "model")), keep) == P("model")
+    assert project_spec(P("model", "data"), keep) == P("model", None)
+    assert project_spec(P("data"), keep) == P()
+    assert project_spec(P(), keep) == P()
+
+
+def test_fsdp_projection_grammar_truth_table():
+    keep = ("data",)
+    assert project_spec(P("data", "model"), keep) == P("data", None)
+    assert project_spec(P(("data", "model")), keep) == P("data")
+    assert project_spec(P("model", "data"), keep) == P(None, "data")
+    assert project_spec(P("data"), keep) == P("data")
+
+
+def test_clamp_degrades_undivisible_dims_to_replicated():
+    # 6 % 4 != 0: the data entry drops; 8 % 4 == 0: it stays
+    assert clamp_spec(P("data", None), (6, 16), {"data": 4}) == P()
+    assert clamp_spec(P("data", None), (8, 16), {"data": 4}) \
+        == P("data", None)
+    # compound entries drop members from the END until the product fits
+    assert clamp_spec(P(("data", "model")), (8,), {"data": 4, "model": 4}) \
+        == P("data")
+    assert clamp_spec(P(("data", "model")), (16,), {"data": 4, "model": 4}) \
+        == P(("data", "model"))
+
+
+def test_auto_fsdp_resolution():
+    # largest evenly-divisible dim takes the data axis...
+    assert fsdp_auto_spec((3, 64, 64, 128), 8) == P(None, None, None, "data")
+    # ...ties/none-dividing degrade to replicated
+    assert fsdp_auto_spec((3, 3), 8) == P()
+    # and under a pure-TP projection AUTO_FSDP resolves to replicated
+    params = {"conv": {"kernel": jnp.zeros((64, 128))}}
+    specs = match_partition_rules(GENERIC_RULES, params,
+                                  keep_axes=("model",))
+    assert specs["conv"]["kernel"] == P()
+    # with the data axis kept + clamped, it IS the ZeRO shard layout
+    specs = match_partition_rules(GENERIC_RULES, params,
+                                  keep_axes=("data",), clamp={"data": 8})
+    assert specs["conv"]["kernel"] == P(None, "data")
+
+
+# ------------------------------------- family tables: serve-TP equivalence
+
+
+def test_vit_rules_project_to_locked_tp_specs():
+    params = _shaped_params("vit_b_32")
+    specs = match_partition_rules(VIT_RULES, params, keep_axes=("model",))
+    layer = specs["encoder"]["encoder_layer_0"]
+    assert layer["mlp_1"]["kernel"] == P(None, "model")
+    assert layer["mlp_1"]["bias"] == P("model")
+    assert layer["mlp_2"]["kernel"] == P("model", None)
+    assert layer["mlp_2"]["bias"] == P()
+    attn = layer["self_attention"]
+    assert attn["in_proj"]["kernel"] == P(None, "model")
+    assert attn["in_proj"]["bias"] == P("model")
+    assert attn["out_proj"]["kernel"] == P("model", None)
+    assert attn["out_proj"]["bias"] == P()
+    assert specs["conv_proj"]["kernel"] == P()
+
+
+def test_swin_v2_rules_project_to_locked_tp_specs():
+    params = _shaped_params("swin_v2_t", px=64)
+    specs = match_partition_rules(SWIN_RULES, params, keep_axes=("model",))
+    flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+    by_path = {
+        "/".join(str(k.key) for k in path): spec for path, spec in flat
+    }
+    qkv_k = [p for p in by_path if p.endswith("qkv/kernel")]
+    proj_k = [p for p in by_path if p.endswith("proj/kernel")
+              and "cpb" not in p]
+    scale = [p for p in by_path if p.endswith("logit_scale")]
+    cpb2 = [p for p in by_path if p.endswith("cpb_mlp_2/kernel")]
+    assert qkv_k and proj_k and scale and cpb2  # v2 carries all four
+    for p in qkv_k:
+        assert by_path[p] == P(None, "model")
+    for p in proj_k:
+        assert by_path[p] == P("model", None)
+    for p in scale:
+        assert by_path[p] == P("model")
+    for p in cpb2:
+        assert by_path[p] == P(None, "model")
+
+
+def test_convnext_rules_project_to_locked_tp_specs():
+    params = _shaped_params("convnext_tiny")
+    specs = match_partition_rules(CONVNEXT_RULES, params,
+                                  keep_axes=("model",))
+    flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+    by_path = {
+        "/".join(str(k.key) for k in path): spec for path, spec in flat
+    }
+    m1 = [p for p in by_path if p.endswith("mlp_1/kernel")]
+    m2 = [p for p in by_path if p.endswith("mlp_2/kernel")]
+    assert m1 and m2
+    for p in m1:
+        assert by_path[p] == P(None, "model")
+    for p in m2:
+        assert by_path[p] == P("model", None)
+    # dwconv / norms / stem stay replicated under pure TP
+    for p, spec in by_path.items():
+        if "mlp_" not in p:
+            assert spec == P(), f"{p} unexpectedly sharded: {spec}"
+
+
+def test_one_table_yields_tp_and_fsdp_views():
+    """THE tentpole property: the same VIT declaration projects to the
+    pure-TP placement AND the ZeRO-3/FSDP layout — placements cannot
+    drift because both are views of one table."""
+    params = _shaped_params("vit_b_32")
+    tp = match_partition_rules(VIT_RULES, params, keep_axes=("model",))
+    fsdp = match_partition_rules(VIT_RULES, params, keep_axes=("data",),
+                                 clamp={"data": 8})
+    layer_tp = tp["encoder"]["encoder_layer_0"]
+    layer_fs = fsdp["encoder"]["encoder_layer_0"]
+    assert layer_tp["mlp_1"]["kernel"] == P(None, "model")
+    assert layer_fs["mlp_1"]["kernel"] == P("data", None)
+    assert layer_tp["mlp_1"]["bias"] == P("model")
+    assert layer_fs["mlp_1"]["bias"] == P("data")
+    # and the generic fallback resolves per-view too (AUTO_FSDP)
+    assert tp["conv_proj"]["kernel"] == P()
+    assert layer_fs["mlp_2"]["bias"] == P("data")
+
+
+# ------------------------------------------------- fingerprints + registry
+
+
+def test_rules_fingerprint_stable_and_sensitive():
+    fp = rules_fingerprint(VIT_RULES)
+    assert fp == rules_fingerprint(VIT_RULES)
+    assert len(fp) == 12 and fp != rules_fingerprint(SWIN_RULES)
+    edited = ((r"(^|/)in_proj/kernel$", P("model", "data")),) + VIT_RULES[1:]
+    assert rules_fingerprint(edited) != fp
+
+
+def test_partition_family_env_override(monkeypatch):
+    assert partition_family("resnet18") == "generic"
+    assert partition_family("vit_b_32") == "vit"
+    monkeypatch.setenv("DPTPU_RULES", "vit")
+    assert partition_family("resnet18") == "vit"
+    assert partition_rules_for_arch("resnet18") is VIT_RULES
+    monkeypatch.setenv("DPTPU_RULES", "bogus")
+    with pytest.raises(ValueError, match="DPTPU_RULES"):
+        partition_family("resnet18")
+
+
+def test_every_family_table_is_well_formed():
+    for family, rules in FAMILY_RULES.items():
+        validate_rules(rules)
+        assert rules[-1][0] == ".*", family
+
+
+# ------------------------------------------- dptpu check: partition-rules
+
+
+def test_partition_check_clean_on_repo_tables():
+    from dptpu.analysis.partition import (
+        check_partition_rules,
+        partition_summary,
+    )
+
+    violations = check_partition_rules()
+    assert violations == []
+    summary = partition_summary(violations)
+    assert summary["ok"] is True
+    assert summary["fingerprints"]["generic"] \
+        == rules_fingerprint(GENERIC_RULES)
+
+
+def test_partition_check_flags_dead_rule_and_fallback_only(monkeypatch):
+    from dptpu.analysis import partition as partition_mod
+    from dptpu.models import registry as registry_mod
+
+    dead_table = (
+        (r"(^|/)no_such_module/kernel$", P("data", "model")),
+        (r".*", AUTO_FSDP),
+    )
+    monkeypatch.setattr(registry_mod, "FAMILY_RULES",
+                        {"generic": dead_table})
+    monkeypatch.setattr(partition_mod, "FAMILY_REPRESENTATIVES",
+                        {"generic": ("resnet18",)})
+    violations = partition_mod.check_partition_rules()
+    msgs = [v.format() for v in violations]
+    assert any("dead rule" in m and "no_such_module" in m for m in msgs)
+    assert any("fallback-only" in m for m in msgs)
+
+
+def test_partition_check_flags_non_mesh_axis(monkeypatch):
+    from dptpu.analysis import partition as partition_mod
+    from dptpu.models import registry as registry_mod
+
+    typo_table = (
+        (r"(^|/)conv1/kernel$", P("modle")),  # typo'd axis
+        (r".*", AUTO_FSDP),
+    )
+    monkeypatch.setattr(registry_mod, "FAMILY_RULES",
+                        {"generic": typo_table})
+    monkeypatch.setattr(partition_mod, "FAMILY_REPRESENTATIVES",
+                        {"generic": ("resnet18",)})
+    violations = partition_mod.check_partition_rules()
+    assert any("non-mesh axes" in v.format() and "modle" in v.format()
+               for v in violations)
