@@ -92,6 +92,12 @@ KNOB_REGISTRY = {
     "DPTPU_SERVE_MAX_DELAY_MS": _k("float", "serve"),
     "DPTPU_SERVE_PLACEMENT": _k("choice", "serve"),
     "DPTPU_SERVE_SLOTS": _k("int", "serve"),
+    "DPTPU_SERVE_QUEUE_DEPTH": _k("int", "serve"),
+    "DPTPU_SERVE_PRIORITIES": _k("str", "serve"),
+    "DPTPU_SERVE_DEADLINE_MS": _k("float", "serve"),
+    "DPTPU_SERVE_CANARY_FRACTION": _k("float", "serve"),
+    "DPTPU_SERVE_CANARY_DRIFT": _k("float", "serve"),
+    "DPTPU_SERVE_CANARY_LAT_FACTOR": _k("float", "serve"),
     # analysis / sanitizers
     "DPTPU_SYNC_CHECK": _k("bool", "analysis"),
     # bench-driver child sentinels (subprocess re-entry guards)

@@ -66,7 +66,9 @@ def build_serve_parser():
                     "continuous dynamic batching (dptpu/serve)",
     )
     p.add_argument("-a", "--arch", default="resnet50", metavar="ARCH",
-                   help="registry architecture (dptpu.models.model_names)")
+                   help="registry architecture, or a comma list of "
+                        "[name=]arch entries to co-serve several models "
+                        "behind one router (e.g. 'resnet50,tiny=resnet18')")
     p.add_argument("--buckets", default=None, metavar="N,N,...",
                    help="AOT batch-size bucket ladder (default 1,4,16,64; "
                         "env DPTPU_SERVE_BUCKETS)")
@@ -79,6 +81,28 @@ def build_serve_parser():
     p.add_argument("--slots", type=int, default=None,
                    help="staging-ring depth (default 4; env "
                         "DPTPU_SERVE_SLOTS)")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   help="admission bound: max admitted-but-unanswered "
+                        "requests per model (default 64; env "
+                        "DPTPU_SERVE_QUEUE_DEPTH)")
+    p.add_argument("--priorities", default=None, metavar="H,N,L",
+                   help="shed water marks as fractions of the queue "
+                        "depth, high,normal,low (default 1.0,0.85,0.6; "
+                        "env DPTPU_SERVE_PRIORITIES)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline, 0 = none "
+                        "(default 0; env DPTPU_SERVE_DEADLINE_MS)")
+    p.add_argument("--canary-fraction", type=float, default=None,
+                   help="traffic fraction routed to a staged canary "
+                        "generation (default 0.1; env "
+                        "DPTPU_SERVE_CANARY_FRACTION)")
+    p.add_argument("--canary-drift", type=float, default=None,
+                   help="max|dlogit| vs baseline before auto-rollback "
+                        "(default 50.0; env DPTPU_SERVE_CANARY_DRIFT)")
+    p.add_argument("--canary-lat-factor", type=float, default=None,
+                   help="canary/baseline batch-latency multiple before "
+                        "auto-rollback (default 5.0; env "
+                        "DPTPU_SERVE_CANARY_LAT_FACTOR)")
     p.add_argument("--pretrained", action="store_true",
                    help="load converted torchvision weights "
                         "($DPTPU_PRETRAINED_DIR/<arch>.npz)")
@@ -93,85 +117,131 @@ def build_serve_parser():
     return p
 
 
+def parse_model_specs(raw: str):
+    """``[name=]arch[,...]`` -> ordered (name, arch) pairs; the first
+    entry is the router's default route. A bare arch names itself, so
+    co-serving the same arch twice needs explicit names."""
+    from dptpu.models import model_names
+
+    pairs = []
+    for spec in str(raw).split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        name, _, arch = spec.rpartition("=")
+        name = name or arch
+        if arch not in model_names():
+            raise ValueError(
+                f"--arch={arch!r} is not a registry architecture "
+                f"(e.g. {', '.join(model_names()[:4])}, ...; full list: "
+                f"python -c 'from dptpu.models import model_names; "
+                f"print(model_names())')"
+            )
+        if name in (n for n, _ in pairs):
+            raise ValueError(
+                f"--arch names model {name!r} twice (use name=arch to "
+                f"co-serve one arch under distinct names)"
+            )
+        pairs.append((name, arch))
+    if not pairs:
+        raise ValueError("--arch needs at least one [name=]arch entry")
+    return pairs
+
+
 def serve_args_to_knobs(args):
     """CLI namespace -> validated ServeKnobs + arch check (the fail-fast
     moment: every bad knob OR unknown name raises BEFORE any compile)."""
-    from dptpu.models import model_names
     from dptpu.serve import serve_knobs
 
     knobs = serve_knobs(
         buckets=args.buckets, max_delay_ms=args.max_delay_ms,
         placement=args.placement, slots=args.slots,
+        queue_depth=args.queue_depth, priorities=args.priorities,
+        deadline_ms=args.deadline_ms,
+        canary_fraction=args.canary_fraction,
+        canary_drift=args.canary_drift,
+        canary_lat_factor=args.canary_lat_factor,
     )
-    if args.arch not in model_names():
-        raise ValueError(
-            f"--arch={args.arch!r} is not a registry architecture "
-            f"(e.g. {', '.join(model_names()[:4])}, ...; full list: "
-            f"python -c 'from dptpu.models import model_names; "
-            f"print(model_names())')"
-        )
+    parse_model_specs(args.arch)
     return knobs
 
 
 def main_serve(argv=None):
-    """``dptpu serve``: load a model, AOT-compile the bucket ladder,
-    and serve — over HTTP, or ``--selftest N`` synthetic requests."""
+    """``dptpu serve``: load the model(s), AOT-compile each bucket
+    ladder, and serve — over HTTP, or ``--selftest N`` synthetic
+    requests."""
     args = build_serve_parser().parse_args(argv)
     knobs = serve_args_to_knobs(args)  # fail fast, pre-jax-compile
+    specs = parse_model_specs(args.arch)
 
-    from dptpu.serve import DynamicBatcher, ServeEngine
+    from dptpu.serve import ModelRouter, build_served_model
 
-    engine = ServeEngine(
-        args.arch, buckets=knobs.buckets, placement=knobs.placement,
-        num_classes=args.num_classes, image_size=args.image_size,
-        pretrained=args.pretrained, verbose=True,
-    )
-    batcher = DynamicBatcher(
-        engine, max_delay_ms=knobs.max_delay_ms, slots=knobs.slots
-    )
+    router = ModelRouter([
+        build_served_model(
+            name, arch, knobs, num_classes=args.num_classes,
+            image_size=args.image_size, pretrained=args.pretrained,
+            verbose=True,
+        )
+        for name, arch in specs
+    ])
     try:
         if args.selftest:
-            return _serve_selftest(batcher, args.selftest)
+            return _serve_selftest(router, args.selftest)
         print(
-            f"=> dptpu serve: {args.arch} ({engine.placement}, buckets "
+            f"=> dptpu serve: "
+            f"{', '.join(f'{n} ({a})' for n, a in specs)} (buckets "
             f"{list(knobs.buckets)}) on http://{args.host}:{args.port} "
-            f"— POST /predict, GET /healthz, GET /metrics"
+            f"— POST /predict[/<model>], GET /healthz, GET /readyz, "
+            f"GET /metrics"
         )
         from dptpu.serve.http import serve_forever
 
-        serve_forever(batcher, args.host, args.port)
-        return {"served": batcher.stats()["completed"]}
+        serve_forever(router, args.host, args.port)
+        return {
+            name: m.batcher.stats()["completed"]
+            for name, m in router.models.items()
+        }
     finally:
-        batcher.close()
+        router.close()
 
 
-def _serve_selftest(batcher, n: int):
-    """Readiness probe: N JPEG-encoded synthetic requests through the
-    full bytes -> preprocess -> staging -> bucket -> logits path."""
+def _serve_selftest(router, n: int):
+    """Readiness probe: N JPEG-encoded synthetic requests per model
+    through the full admission -> bytes -> preprocess -> staging ->
+    bucket -> logits path."""
     import io
 
     import numpy as np
     from PIL import Image
 
-    rng = np.random.RandomState(0)
-    size = batcher.engine.image_size
-    futs = []
-    for _ in range(n):
-        buf = io.BytesIO()
-        Image.fromarray(
-            rng.randint(0, 256, (size, size, 3), dtype=np.uint8)
-        ).save(buf, format="JPEG")
-        futs.append(batcher.submit_bytes(buf.getvalue()))
-    for f in futs:
-        f.result(timeout=120.0)
-    stats = batcher.stats()
-    print(
-        f"serve selftest: {stats['completed']} ok, {stats['failed']} "
-        f"failed, p50 {stats['latency_ms']['p50']:.1f}ms p99 "
-        f"{stats['latency_ms']['p99']:.1f}ms, buckets "
-        f"{stats['bucket_counts']}"
-    )
-    return stats
+    out = {}
+    for name, m in router.models.items():
+        rng = np.random.RandomState(0)
+        size = m.engine.image_size
+        # keep outstanding work under the admission water mark: the
+        # selftest proves the path, it must not shed itself
+        window = max(1, m.admission.thresholds["normal"] // 2)
+        futs = []
+        for _ in range(n):
+            buf = io.BytesIO()
+            Image.fromarray(
+                rng.randint(0, 256, (size, size, 3), dtype=np.uint8)
+            ).save(buf, format="JPEG")
+            if len(futs) >= window:
+                futs.pop(0).result(timeout=120.0)
+            futs.append(router.submit(data=buf.getvalue(), model=name))
+        for f in futs:
+            f.result(timeout=120.0)
+        stats = m.batcher.stats()
+        print(
+            f"serve selftest [{name}]: {stats['completed']} ok, "
+            f"{stats['failed']} failed, p50 "
+            f"{stats['latency_ms']['p50']:.1f}ms p99 "
+            f"{stats['latency_ms']['p99']:.1f}ms, buckets "
+            f"{stats['bucket_counts']}"
+        )
+        out[name] = stats
+    return out if len(out) > 1 else next(iter(out.values()))
 
 
 def build_pack_parser():

@@ -51,6 +51,26 @@ naturally):
   (``worker_hang@index=K@s=2@worker=0``): only W stalls, so the
   speculative re-issue path can hand the span to a healthy worker.
 
+Serve-side kinds (ISSUE 17 — injected by the serving tier,
+dptpu/serve/batcher.py and the canary controller):
+
+* ``serve_exception@request=N`` — the N-th request submitted to the
+  batcher raises at the SUBMISSION boundary (before it claims a
+  staging row). Exercises "a bad request fails alone": the caller gets
+  the error, no batch and no row is touched.
+* ``preprocess_crash@request=N`` — the N-th request's preprocessing
+  raises AFTER its staging row is claimed. Exercises the
+  fail-alone-in-batch path: the crashed request's future fails, its
+  row is evicted at dispatch, every other request in the batch
+  resolves normally.
+* ``slow_model:factor=F`` — every dispatched bucket execution sleeps
+  ``F x 20 ms`` before the compiled call (``factor`` > 1). Inflates
+  service time without touching the engine: drives overload so
+  admission shedding engages before the staging ring blocks.
+* ``canary_drift`` — the next canary rollout stages PERTURBED weights
+  (the controller adds a large constant to every parameter), so the
+  logit-drift gate must fire and auto-rollback must trigger.
+
 Worker-side kinds (``io_error``, ``worker_hang``) take effect in spawned
 decode workers, which re-parse the inherited environment — no pickling of
 the plan is needed. Trainer-side kinds fire from ``on_step``; step counts
@@ -72,9 +92,11 @@ from typing import Callable, Optional
 from dptpu.envknob import env_int, env_str
 
 _KINDS = ("sigterm", "worker_kill", "ckpt_truncate", "io_error",
-          "worker_hang", "sigterm_one_host", "host_lost", "slow_host")
+          "worker_hang", "sigterm_one_host", "host_lost", "slow_host",
+          "serve_exception", "preprocess_crash", "slow_model",
+          "canary_drift")
 _HANG_SECONDS = 3600.0
-_SLOW_BASE_S = 0.02  # slow_host: seconds of sleep per unit of factor
+_SLOW_BASE_S = 0.02  # slow_host/slow_model: sleep per unit of factor
 
 
 @dataclasses.dataclass
@@ -86,7 +108,8 @@ class _Fault:
     p: float = 0.0
     seconds: Optional[float] = None  # worker_hang: bounded straggler sleep
     worker: Optional[int] = None  # worker_hang/slow_host: worker id
-    factor: Optional[float] = None  # slow_host: slowdown multiple (> 1)
+    factor: Optional[float] = None  # slow_host/slow_model: multiple (> 1)
+    request: Optional[int] = None  # serve_exception/preprocess_crash
     fired: bool = False
 
 
@@ -127,12 +150,17 @@ def _parse_one(spec: str) -> _Fault:
                 f.factor = float(val)
                 if f.factor <= 1.0:
                     raise ValueError
+            elif key == "request":
+                f.request = int(val)
+                if f.request < 1:
+                    raise ValueError
             else:
                 raise KeyError
         except KeyError:
             raise ValueError(
                 f"DPTPU_FAULT modifier key {key!r} in {spec!r} unknown "
-                f"(accepted: step, save, index, p, s, worker, factor)"
+                f"(accepted: step, save, index, p, s, worker, factor, "
+                f"request)"
             ) from None
         except ValueError:
             raise ValueError(
@@ -151,6 +179,18 @@ def _parse_one(spec: str) -> _Fault:
         raise ValueError(
             f"DPTPU_FAULT {spec!r} needs :factor=F with F > 1 (the "
             f"straggler's slowdown multiple, e.g. slow_host:factor=5)"
+        )
+    if f.kind in ("serve_exception", "preprocess_crash") \
+            and f.request is None:
+        raise ValueError(
+            f"DPTPU_FAULT {spec!r} needs @request=N with N >= 1 (the "
+            f"1-based submission that fails, e.g. "
+            f"serve_exception@request=3)"
+        )
+    if f.kind == "slow_model" and f.factor is None:
+        raise ValueError(
+            f"DPTPU_FAULT {spec!r} needs :factor=F with F > 1 (the "
+            f"per-batch service-time multiple, e.g. slow_model:factor=5)"
         )
     return f
 
@@ -268,6 +308,48 @@ class FaultPlan:
                 raise OSError(
                     f"injected io_error (p={f.p}) on store op {desc!r}"
                 )
+
+    # -- serve-side hooks ---------------------------------------------------
+
+    def on_serve_submit(self, request_index: int):
+        """Call per batcher submission (1-based), BEFORE a staging row
+        is claimed: ``serve_exception@request=N`` makes the N-th
+        submission raise at the boundary — the caller gets the error,
+        nothing else is touched."""
+        for f in self.faults:
+            if f.kind == "serve_exception" and not f.fired \
+                    and request_index == f.request:
+                f.fired = True
+                raise RuntimeError(
+                    f"injected serve_exception on request {request_index}"
+                )
+
+    def on_serve_preprocess(self, request_index: int):
+        """Call per request preprocess (1-based submission index), AFTER
+        its staging row is claimed: ``preprocess_crash@request=N`` makes
+        the N-th request's decode raise — the fail-alone-in-batch path."""
+        for f in self.faults:
+            if f.kind == "preprocess_crash" and not f.fired \
+                    and request_index == f.request:
+                f.fired = True
+                raise RuntimeError(
+                    f"injected preprocess_crash on request {request_index}"
+                )
+
+    def serve_model_delay_s(self) -> float:
+        """Per-dispatched-batch extra service time: ``slow_model:factor=F``
+        contributes ``F x 20 ms`` per bucket execution (0.0 unarmed)."""
+        return sum(
+            _SLOW_BASE_S * f.factor for f in self.faults
+            if f.kind == "slow_model"
+        )
+
+    def canary_drift_armed(self) -> bool:
+        """True when ``canary_drift`` is armed: the canary controller
+        stages PERTURBED weights so the drift gate must fire (this
+        module stays stdlib-only — the numeric perturbation lives in
+        dptpu/serve/canary.py)."""
+        return any(f.kind == "canary_drift" for f in self.faults)
 
     # -- worker-side hook ---------------------------------------------------
 

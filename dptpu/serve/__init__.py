@@ -21,8 +21,20 @@ vertical:
 * :func:`preprocess_bytes` (preprocess.py) — request bytes -> the
   pixel-exact validation pixels (``ValTransform``), bit-identical to
   the training/eval pipeline's val path.
-* knob contract (knobs.py) + stdlib HTTP listener (http.py) behind the
-  ``dptpu serve`` CLI subcommand (dptpu/cli.py).
+* :class:`AdmissionController` (admission.py) — bounded per-model
+  queues with priority water marks and deadline-feasibility shedding
+  (fast 429/503 + ``Retry-After``), so overload p99 stays bounded at
+  the admission boundary, not just by ring backpressure.
+* :class:`CanaryController` (canary.py) — gated rollout of a staged
+  generation: a traffic fraction pins gen N+1, shadow evals replay its
+  inputs through gen N, drift/latency breaches auto-rollback LOUDLY.
+* :class:`ModelRouter` (router.py) — N co-resident engines (different
+  archs and/or generations) behind one submit/readiness surface, each
+  with its own queue, ladder and admission gate.
+* knob contract (knobs.py) + stdlib HTTP listener (http.py — liveness
+  ``/healthz``, readiness ``/readyz``, ``/predict[/<model>]`` with
+  priority/deadline headers) behind the ``dptpu serve`` CLI subcommand
+  (dptpu/cli.py).
 
 Benchmarked by ``scripts/run_servebench.py`` (SERVEBENCH.json: p50/p99
 latency x offered-load curves closed- and open-loop, saturation
@@ -34,35 +46,64 @@ load lazily so the CLI can validate knobs — and the conftest leak guard
 can police staging segments — without touching a backend.
 """
 
+from dptpu.serve.admission import (
+    AdmissionController,
+    AdmissionError,
+    AdmissionTicket,
+)
 from dptpu.serve.knobs import (
     DEFAULT_BUCKETS,
+    DEFAULT_CANARY_DRIFT,
+    DEFAULT_CANARY_FRACTION,
+    DEFAULT_CANARY_LAT_FACTOR,
+    DEFAULT_DEADLINE_MS,
     DEFAULT_MAX_DELAY_MS,
+    DEFAULT_PRIORITIES,
+    DEFAULT_QUEUE_DEPTH,
     DEFAULT_SLOTS,
     PLACEMENTS,
+    PRIORITY_NAMES,
     ServeKnobs,
     parse_buckets,
+    parse_priorities,
     serve_knobs,
 )
 from dptpu.serve.preprocess import preprocess_array, preprocess_bytes
 
 __all__ = [
     "DEFAULT_BUCKETS", "DEFAULT_MAX_DELAY_MS", "DEFAULT_SLOTS",
-    "PLACEMENTS", "ServeKnobs", "parse_buckets", "serve_knobs",
-    "preprocess_bytes", "preprocess_array",
+    "DEFAULT_QUEUE_DEPTH", "DEFAULT_PRIORITIES", "DEFAULT_DEADLINE_MS",
+    "DEFAULT_CANARY_FRACTION", "DEFAULT_CANARY_DRIFT",
+    "DEFAULT_CANARY_LAT_FACTOR", "PRIORITY_NAMES",
+    "PLACEMENTS", "ServeKnobs", "parse_buckets", "parse_priorities",
+    "serve_knobs", "preprocess_bytes", "preprocess_array",
+    "AdmissionController", "AdmissionError", "AdmissionTicket",
     "ServeEngine", "DynamicBatcher", "ServeFuture", "ServeError",
+    "ServeCancelled", "DeadlineExceeded", "CanaryController",
+    "ModelRouter", "ServedModel", "build_served_model",
     "resolve_placement",
 ]
 
 
 def __getattr__(name):
-    # lazy jax-side surface: ServeEngine/DynamicBatcher import the
-    # backend; the knob/preprocess surface above stays import-light
+    # lazy jax-side surface: ServeEngine/DynamicBatcher/router import
+    # the backend; the knob/preprocess/admission surface above stays
+    # import-light
     if name in ("ServeEngine", "resolve_placement"):
         from dptpu.serve import engine
 
         return getattr(engine, name)
-    if name in ("DynamicBatcher", "ServeFuture", "ServeError"):
+    if name in ("DynamicBatcher", "ServeFuture", "ServeError",
+                "ServeCancelled", "DeadlineExceeded"):
         from dptpu.serve import batcher
 
         return getattr(batcher, name)
+    if name == "CanaryController":
+        from dptpu.serve.canary import CanaryController
+
+        return CanaryController
+    if name in ("ModelRouter", "ServedModel", "build_served_model"):
+        from dptpu.serve import router
+
+        return getattr(router, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

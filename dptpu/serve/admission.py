@@ -1,0 +1,179 @@
+"""Admission control: bounded queues, priorities, deadline feasibility.
+
+ISSUE 17 tentpole (a). The staging ring already bounds MEMORY (submit
+blocks when every slot is leased), but blocking is the WRONG overload
+response for a latency-bounded tier: a request that will wait longer
+than its deadline should be rejected in microseconds, not queued into
+a p99 explosion. This layer sits in FRONT of the batcher and answers
+one question per request — *can this request plausibly be served within
+its deadline, and is there room for its priority class?* — without
+touching the device path:
+
+* **Bounded occupancy** — at most ``DPTPU_SERVE_QUEUE_DEPTH`` requests
+  may be admitted-but-unanswered per model. Occupancy is taken at
+  ``try_admit`` and released by a :class:`ServeFuture` done-callback,
+  so it counts the WHOLE lifecycle (queue + preprocess + coalesce +
+  device), not just a queue length.
+
+* **Priority water marks** (``DPTPU_SERVE_PRIORITIES``, fractions of
+  the depth, non-increasing high→normal→low): a priority class is shed
+  with **503** once occupancy crosses its mark, so low-priority traffic
+  drains first and high-priority traffic still lands at full depth.
+  503 = "the server is saturated, back off" and carries ``Retry-After``
+  derived from the service-time EWMA.
+
+* **Deadline feasibility** — a request whose deadline budget is below
+  the observed service-time EWMA cannot succeed; it is rejected
+  immediately with **429** (the client asked for the impossible —
+  retrying the same deadline will fail again, so no ``Retry-After``).
+
+Shedding happens entirely under one mutex with no allocation or device
+work, so the rejection fast-path stays orders of magnitude below a
+service time — SERVEBENCH's overload arm gates on exactly that.
+
+Lock order: ``serve.admission`` (rank 15) sits ABOVE the batcher lock
+(rank 10) because releases run inside future done-callbacks fired under
+the batcher's condition, and BELOW the engine lock (rank 20).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+from dptpu.serve.knobs import (
+    DEFAULT_DEADLINE_MS,
+    DEFAULT_PRIORITIES,
+    DEFAULT_QUEUE_DEPTH,
+    PRIORITY_NAMES,
+)
+from dptpu.utils.sync import OrderedLock
+
+
+class AdmissionError(RuntimeError):
+    """Request shed at the admission boundary; carries the HTTP status
+    (429 infeasible deadline / 503 saturated) and an optional
+    ``Retry-After`` hint in seconds."""
+
+    def __init__(self, msg: str, status: int,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionTicket:
+    """One admitted request's occupancy claim. ``deadline`` is the
+    absolute ``time.perf_counter()`` second the batcher must beat (None
+    = unbounded); release is idempotent (disconnect paths may race the
+    done-callback)."""
+
+    __slots__ = ("priority", "deadline", "t_admit", "released")
+
+    def __init__(self, priority: str, deadline: Optional[float],
+                 t_admit: float):
+        self.priority = priority
+        self.deadline = deadline
+        self.t_admit = t_admit
+        self.released = False  # flipped under the controller's _lock
+
+
+class AdmissionController:
+    """Per-model admission gate; see the module docstring for policy."""
+
+    def __init__(self, depth: int = DEFAULT_QUEUE_DEPTH,
+                 priorities: Sequence[float] = DEFAULT_PRIORITIES,
+                 deadline_ms: float = DEFAULT_DEADLINE_MS,
+                 service_hint_ms: float = 50.0,
+                 name: str = "default"):
+        if depth < 1:
+            raise ValueError(f"queue depth {depth} must be >= 1")
+        self.name = name
+        self.depth = depth
+        self.default_deadline_ms = deadline_ms
+        # water mark per class: occupancy at/above it sheds the class
+        self.thresholds: Dict[str, int] = {
+            cls: max(1, round(depth * frac))
+            for cls, frac in zip(PRIORITY_NAMES, priorities)
+        }
+        self._lock = OrderedLock("serve.admission")
+        self._occupancy = 0  # guarded-by: _lock
+        self._admitted = 0  # guarded-by: _lock
+        self._shed_queue = 0  # guarded-by: _lock
+        self._shed_deadline = 0  # guarded-by: _lock
+        # EWMA of observed end-to-end service time; seeded with a hint
+        # so feasibility works before the first completion
+        self._service_ewma_ms = service_hint_ms  # guarded-by: _lock
+
+    # -- the gate -------------------------------------------------------
+
+    def try_admit(self, priority: str = "normal",
+                  deadline_ms: Optional[float] = None) -> AdmissionTicket:
+        """Admit one request or raise :class:`AdmissionError` (fast, no
+        allocation, no device work). ``deadline_ms`` is the request's
+        RELATIVE budget; None falls back to the model's default
+        (``DPTPU_SERVE_DEADLINE_MS``); 0/None-default = no deadline."""
+        if priority not in self.thresholds:
+            raise ValueError(
+                f"priority {priority!r} is not one of {PRIORITY_NAMES}"
+            )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        now = time.perf_counter()
+        with self._lock:
+            est = self._service_ewma_ms
+            if deadline_ms and deadline_ms < est:
+                self._shed_deadline += 1
+                raise AdmissionError(
+                    f"deadline {deadline_ms:.0f} ms is below the "
+                    f"observed service time (~{est:.0f} ms): infeasible",
+                    status=429,
+                )
+            mark = self.thresholds[priority]
+            if self._occupancy >= mark:
+                self._shed_queue += 1
+                excess = self._occupancy - mark + 1
+                retry = max(0.05, excess * est / 1e3)
+                raise AdmissionError(
+                    f"{self.name}: {self._occupancy} in flight >= "
+                    f"{priority} water mark {mark} (depth {self.depth})",
+                    status=503, retry_after_s=retry,
+                )
+            self._occupancy += 1
+            self._admitted += 1
+        deadline = now + deadline_ms / 1e3 if deadline_ms else None
+        return AdmissionTicket(priority, deadline, now)
+
+    def release(self, ticket: AdmissionTicket,
+                service_ms: Optional[float] = None) -> None:
+        """Return ``ticket``'s occupancy claim; idempotent. Successful
+        completions pass their end-to-end latency to keep the
+        feasibility EWMA honest."""
+        with self._lock:
+            if ticket.released:
+                return
+            ticket.released = True
+            self._occupancy -= 1
+            if service_ms is not None:
+                self._service_ewma_ms += \
+                    0.2 * (service_ms - self._service_ewma_ms)
+
+    # -- introspection --------------------------------------------------
+
+    def shedding_hard(self) -> bool:
+        """True while even NORMAL-priority traffic is being shed — the
+        readiness signal: a fleet router should stop sending here."""
+        with self._lock:
+            return self._occupancy >= self.thresholds["normal"]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "occupancy": self._occupancy,
+                "depth": self.depth,
+                "admitted": self._admitted,
+                "shed_queue": self._shed_queue,
+                "shed_deadline": self._shed_deadline,
+                "service_ewma_ms": self._service_ewma_ms,
+                "thresholds": dict(self.thresholds),
+            }

@@ -18,6 +18,17 @@ bytes -> pixels; ``serve_batch_wait`` — coalescing delay;
 ``serve_postprocess`` — logit slicing/top-k) and the serve metrics
 group on the registry (``Serve/qps``, ``Serve/p99_ms``,
 ``Serve/bucket_occupancy``, ``Serve/padding_waste``).
+
+Request lifecycle (ISSUE 17 tentpole (b)): every request may carry an
+absolute DEADLINE (``time.perf_counter()`` seconds); an expired or
+client-cancelled request is evicted while it coalesces — it fails fast
+with :class:`DeadlineExceeded`/:class:`ServeCancelled`, its row is
+COMPACTED away before execution (a dead request occupies zero bucket
+rows, proven by the padding-waste accounting), and the ``max_delay_ms``
+coalescing timer re-anchors onto the oldest LIVE request so a corpse
+never drives dispatch cadence. Serve-side ``DPTPU_FAULT`` hooks
+(``serve_exception`` / ``preprocess_crash`` / ``slow_model``) inject at
+the submit, preprocess, and execute boundaries.
 """
 
 from __future__ import annotations
@@ -30,6 +41,7 @@ import numpy as np
 
 from dptpu import obs
 from dptpu.data.transforms import ValTransform
+from dptpu.resilience.faults import FaultPlan
 from dptpu.serve.preprocess import preprocess_bytes, val_resize_for
 from dptpu.serve.staging import StagingRing
 from dptpu.utils.sync import OrderedLock
@@ -39,31 +51,89 @@ class ServeError(RuntimeError):
     pass
 
 
+class ServeCancelled(ServeError):
+    """The request was withdrawn (client disconnect / explicit cancel)
+    before its batch dispatched."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired before its logits materialized."""
+
+
 class ServeFuture:
     """One request's pending result; ``result()`` blocks for the logits
-    (float32 ``[num_classes]``) or re-raises the request's failure."""
+    (float32 ``[num_classes]``) or re-raises the request's failure.
+    ``cancel()`` withdraws a still-coalescing request (the HTTP layer's
+    client-disconnect path); ``add_done_callback`` runs exactly once on
+    completion (the admission layer's occupancy release)."""
 
-    __slots__ = ("_event", "_logits", "_error", "generation", "timings")
+    __slots__ = ("_event", "_cb_lock", "_done_cbs", "_cancel_cb",
+                 "_logits", "_error", "generation", "timings")
 
-    def __init__(self):
+    def __init__(self, cancel_cb=None):
         self._event = threading.Event()
-        self._logits = None  # owned-by: dispatcher
-        self._error = None  # owned-by: dispatcher
-        self.generation = None  # owned-by: dispatcher
-        self.timings: Dict[str, float] = {}  # owned-by: dispatcher
-        # all four are written once by the fulfilling thread BEFORE
-        # _event.set() and read only after _event.wait() returns — the
-        # Event is the publication barrier (single-writer handoff)
+        # raw leaf Lock (no rank): held only for list/flag flips, never
+        # while acquiring a ranked lock — callbacks run AFTER release
+        self._cb_lock = threading.Lock()
+        self._done_cbs: list = []  # guarded-by: _cb_lock
+        self._cancel_cb = cancel_cb
+        self._logits = None  # owned-by: completer
+        self._error = None  # owned-by: completer
+        self.generation = None  # owned-by: completer
+        self.timings: Dict[str, float] = {}  # owned-by: completer
+        # the payload attrs are written once by the COMPLETING thread
+        # before _event.set() and read only after _event.wait() returns
+        # — the Event is the publication barrier; _cb_lock arbitrates
+        # WHICH thread completes (dispatcher fulfil vs cancel/deadline
+        # failure race first-wins, losers are dropped)
 
-    def _fulfill(self, logits, generation, timings):
-        self._logits = logits
-        self.generation = generation
-        self.timings = timings
-        self._event.set()
+    def _complete(self, error, logits=None, generation=None,
+                  timings=None) -> bool:
+        with self._cb_lock:
+            if self._event.is_set():
+                return False  # first completion wins
+            self._error = error
+            self._logits = logits
+            if generation is not None:
+                self.generation = generation
+            if timings is not None:
+                self.timings = timings
+            self._event.set()
+            cbs, self._done_cbs = self._done_cbs, []
+        for cb in cbs:  # off-lock: callbacks may take ranked locks
+            try:
+                cb(self)
+            except Exception:
+                pass
+        return True
 
-    def _fail(self, exc):
-        self._error = exc
-        self._event.set()
+    def _fulfill(self, logits, generation, timings) -> bool:
+        return self._complete(None, logits, generation, timings)
+
+    def _fail(self, exc) -> bool:
+        return self._complete(exc)
+
+    def add_done_callback(self, fn) -> None:
+        """Arrange ``fn(self)`` to run when the request completes; an
+        already-done future runs it immediately on the caller's thread.
+        Callback exceptions are swallowed (they must not kill the
+        dispatcher)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._done_cbs.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:
+            pass
+
+    def cancel(self) -> bool:
+        """Withdraw the request if its batch has not dispatched; True
+        when the cancellation took (``result()`` raises
+        :class:`ServeCancelled`, the staged row is compacted away)."""
+        if self._cancel_cb is None:
+            return False
+        return self._cancel_cb()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -77,26 +147,39 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("future", "row", "t_arrive", "t_ready", "ready", "failed")
+    __slots__ = ("future", "row", "t_arrive", "t_ready", "deadline",
+                 "ready", "failed", "cancelled", "dispatched")
 
-    def __init__(self, row: int, t_arrive: float):
-        self.future = ServeFuture()
+    def __init__(self, row: int, t_arrive: float,
+                 deadline: Optional[float], canceller):
+        self.future = ServeFuture(
+            cancel_cb=(lambda: canceller(self)) if canceller else None
+        )
         self.row = row
         self.t_arrive = t_arrive
         self.t_ready = 0.0
+        self.deadline = deadline  # absolute perf_counter s, or None
         self.ready = False
         self.failed = False
+        self.cancelled = False
+        self.dispatched = False
 
 
 class DynamicBatcher:
     """Continuous batcher over one :class:`ServeEngine`."""
 
-    def __init__(self, engine, max_delay_ms: float = 5.0, slots: int = 4):
+    def __init__(self, engine, max_delay_ms: float = 5.0, slots: int = 4,
+                 canary=None, fault_plan: Optional[FaultPlan] = None):
         if max_delay_ms < 0:
             raise ValueError(
                 f"max_delay_ms={max_delay_ms} must be >= 0"
             )
         self.engine = engine
+        # generation picker + drift observer for canary rollout; None =
+        # every batch pins the engine's current generation
+        self._canary = canary
+        self._plan = fault_plan if fault_plan is not None \
+            else FaultPlan.from_env()
         self.max_delay_s = max_delay_ms / 1e3
         item = (engine.image_size, engine.image_size, 3)
         # rows per slot = the LARGEST bucket's executable size, so pad
@@ -119,6 +202,10 @@ class DynamicBatcher:
         # telemetry
         self._completed = 0  # guarded-by: _lock
         self._failed = 0  # guarded-by: _lock
+        self._cancelled = 0  # guarded-by: _lock
+        self._expired = 0  # guarded-by: _lock
+        self._dead_rows = 0  # guarded-by: _lock
+        self._submit_seq = 0  # guarded-by: _lock
         self._batches = 0  # guarded-by: _lock
         self._batch_seq = 0  # guarded-by: _lock
         self._bucket_counts: Dict[int, int] = {}  # guarded-by: _lock
@@ -136,24 +223,44 @@ class DynamicBatcher:
 
     # -- submission -----------------------------------------------------
 
-    def submit_bytes(self, data: bytes) -> ServeFuture:
+    def submit_bytes(self, data: bytes,
+                     deadline: Optional[float] = None) -> ServeFuture:
         """Enqueue one request from image bytes (any PIL-decodable
         container); decoding runs on the CALLER's thread — submission
-        concurrency is the preprocessing parallelism."""
-        return self._submit(data, None)
+        concurrency is the preprocessing parallelism. ``deadline`` is an
+        absolute ``time.perf_counter()`` second past which the request
+        is evicted instead of served."""
+        return self._submit(data, None, deadline)
 
-    def submit_array(self, img: np.ndarray) -> ServeFuture:
+    def submit_array(self, img: np.ndarray,
+                     deadline: Optional[float] = None) -> ServeFuture:
         """Enqueue an already-preprocessed uint8 HWC tensor (the bench's
         decode-free path; shape must match the engine's image size)."""
-        return self._submit(None, img)
+        return self._submit(None, img, deadline)
 
-    def _submit(self, data, img) -> ServeFuture:
+    def _submit(self, data, img, deadline) -> ServeFuture:
         tracer = obs.get_tracer()
         t_arrive = time.perf_counter()
+        with self._cond:
+            self._submit_seq += 1
+            seq = self._submit_seq
+        if self._plan is not None:
+            try:
+                self._plan.on_serve_submit(seq)  # fault hook
+            except Exception as e:
+                raise ServeError(f"request rejected: {e}")
         with self._cond:
             while True:
                 if self._closing:
                     raise ServeError("batcher is shut down")
+                if deadline is not None and \
+                        time.perf_counter() >= deadline:
+                    # expired while blocked on ring backpressure: fail
+                    # fast WITHOUT claiming a row
+                    raise DeadlineExceeded(
+                        "request deadline expired before a staging row "
+                        "freed"
+                    )
                 if self._open is None:
                     slot = self._ring.acquire()
                     if slot is not None:
@@ -165,7 +272,8 @@ class DynamicBatcher:
                 # every slot leased or the open one is full mid-decode:
                 # backpressure (bounded ring), not an unbounded queue
                 self._cond.wait(0.05)
-            req = _Request(len(self._open_reqs), t_arrive)
+            req = _Request(len(self._open_reqs), t_arrive, deadline,
+                           self._cancel)
             self._open_reqs.append(req)
             slot = self._open
             row_view = self._ring.rows(slot)[req.row]
@@ -173,6 +281,8 @@ class DynamicBatcher:
         if t_row - t_arrive > 1e-4:
             tracer.record("serve_queue", t_arrive, t_row - t_arrive)
         try:
+            if self._plan is not None:
+                self._plan.on_serve_preprocess(seq)  # fault hook
             if img is not None:
                 if img.shape != row_view.shape:
                     raise ValueError(
@@ -204,28 +314,74 @@ class DynamicBatcher:
             self._cond.notify_all()
         return req.future
 
+    def _cancel(self, req: _Request) -> bool:
+        """Withdraw ``req`` while it is still coalescing: its row is
+        marked dead (compacted away at dispatch), the ``max_delay_ms``
+        timer re-anchors onto the next-oldest LIVE request, and its
+        future fails with :class:`ServeCancelled`. False once the batch
+        has dispatched — device work cannot be unclaimed."""
+        with self._cond:
+            if req.dispatched or req.future.done():
+                return False
+            req.cancelled = True
+            self._cancelled += 1
+            self._cond.notify_all()
+        return req.future._fail(ServeCancelled("request cancelled"))
+
     # -- dispatch -------------------------------------------------------
 
     def _dispatchable_locked(self):
         """(slot, reqs) when the open slot should dispatch NOW, else
-        (None, deadline): all claimed rows decoded AND (bucket_max full
-        OR oldest ready request older than the budget OR closing)."""
+        (None, wake): all claimed rows decoded AND (bucket_max full OR
+        oldest LIVE ready request older than the budget OR closing OR
+        every claimed row dead). Deadline-expired requests are evicted
+        here: they fail fast, stop anchoring the coalescing timer, and
+        their rows are compacted away before execution. ``wake`` is the
+        next instant a time-based condition can flip (coalesce budget or
+        the earliest live deadline)."""
         reqs = self._open_reqs
         if self._open is None or not reqs:
             return None, None
+        now = time.perf_counter()
+        for r in reqs:
+            if not r.failed and not r.cancelled and \
+                    r.deadline is not None and now >= r.deadline:
+                r.cancelled = True
+                self._expired += 1
+                # done-callbacks run under the batcher lock (rank 10);
+                # admission release (rank 15) nests legally above it
+                r.future._fail(DeadlineExceeded(
+                    "request deadline expired while coalescing"
+                ))
         if not all(r.ready for r in reqs):
-            return None, None  # a decode is mid-flight; it will notify
-        oldest = min(r.t_ready for r in reqs if not r.failed) \
-            if any(not r.failed for r in reqs) else 0.0
+            # a decode is mid-flight (it will notify); dead rows also
+            # wait here — compaction must never copy over a row a
+            # preprocess thread is still writing
+            return None, None
+        live = [r for r in reqs if not r.failed and not r.cancelled]
         full = len(reqs) == self._admit_max
-        deadline = oldest + self.max_delay_s
-        if full or self._closing or time.perf_counter() >= deadline \
-                or all(r.failed for r in reqs):
+        if not live:
             slot = self._open
             self._open = None
             self._open_reqs = []
+            for r in reqs:
+                r.dispatched = True
             return (slot, reqs), None
-        return None, deadline
+        # timer re-anchor: only LIVE requests drive dispatch cadence
+        oldest = min(r.t_ready for r in live)
+        coalesce = oldest + self.max_delay_s
+        if full or self._closing or now >= coalesce:
+            slot = self._open
+            self._open = None
+            self._open_reqs = []
+            for r in reqs:
+                r.dispatched = True
+            return (slot, reqs), None
+        wake = coalesce
+        for r in live:
+            if r.deadline is not None and r.deadline < wake:
+                wake = r.deadline
+        return None, wake
 
     def _dispatch_loop(self):
         while True:
@@ -263,23 +419,49 @@ class DynamicBatcher:
 
     def _run_batch(self, slot: int, reqs):
         tracer = obs.get_tracer()
-        live = [r for r in reqs if not r.failed]
+        live = [r for r in reqs if not r.failed and not r.cancelled]
+        dead = len(reqs) - len(live)
+        if dead:
+            with self._lock:
+                self._dead_rows += dead
         if not live:
             self._ring.abandon(slot)
             return
-        n = len(reqs)  # failed rows still occupy their claimed rows
+        rows = self._ring.rows(slot)
+        # dead-request hygiene: compact live rows to the front so a
+        # failed/cancelled/expired request occupies ZERO bucket rows —
+        # the batch executes at the LIVE count's bucket, not the claimed
+        # count's. Rows were claimed in submission order, so r.row is
+        # strictly increasing and the forward copy never clobbers an
+        # unread source row.
+        for i, r in enumerate(live):
+            if r.row != i:
+                np.copyto(rows[i], rows[r.row])
+                r.row = i
+        n = len(live)
         engine = self.engine
         bucket = engine.bucket_for(n)
         nexec = engine.exec_batch(bucket)
-        rows = self._ring.rows(slot)
         for pad in range(n, nexec):
-            np.copyto(rows[pad], rows[live[0].row])
+            np.copyto(rows[pad], rows[0])
         lease = self._ring.lease(slot)
-        gen = engine.acquire_generation()
+        if self._canary is not None:
+            gen = self._canary.pick_generation()
+        else:
+            gen = engine.acquire_generation()
+        shadow = None
+        if self._canary is not None and self._canary.wants_shadow(gen):
+            # snapshot BEFORE the lease recycles the slot under new
+            # requests: the baseline drift replay needs these pixels
+            shadow = np.array(rows[:nexec])
         with self._lock:
             self._batch_seq += 1
             batch_index = self._batch_seq
         t_disp = time.perf_counter()
+        if self._plan is not None:
+            delay = self._plan.serve_model_delay_s()
+            if delay:
+                time.sleep(delay)  # injected slow_model fault
         try:
             logits = engine.run_bucket(bucket, rows[:nexec], n, gen=gen)
         except Exception as e:
@@ -311,6 +493,9 @@ class DynamicBatcher:
             self._latency.observe((t_post - r.t_arrive) * 1e3)
         tracer.record("serve_postprocess", t_post,
                       time.perf_counter() - t_post)
+        if self._canary is not None:
+            self._canary.observe(gen, bucket, n, (t_post - t_disp) * 1e3,
+                                 shadow, logits)
         reg = obs.get_registry()
         occupancy = n / bucket
         waste = (nexec - n) / nexec
@@ -344,6 +529,9 @@ class DynamicBatcher:
             out = {
                 "completed": self._completed,
                 "failed": self._failed,
+                "cancelled": self._cancelled,
+                "expired": self._expired,
+                "dead_rows": self._dead_rows,
                 "batches": self._batches,
                 "qps": qps,
                 "bucket_counts": dict(self._bucket_counts),
@@ -362,6 +550,13 @@ class DynamicBatcher:
         if lat.get("count"):
             reg.gauge("Serve/p99_ms").set(lat["p99"])
         return out
+
+    @property
+    def draining(self) -> bool:
+        """True once ``close`` has begun: accepted requests still
+        resolve, new submissions are refused (the readiness signal)."""
+        with self._lock:
+            return self._closing
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop accepting requests; by default DRAIN what is queued

@@ -1,0 +1,297 @@
+"""Canary rollout with drift/latency gating and loud auto-rollback.
+
+ISSUE 17 tentpole (d). A weight push to a serving fleet is the moment
+most likely to break it, and the failure mode is silent: the new
+checkpoint loads fine, serves fine, and returns confidently wrong
+logits. This controller turns the engine's generation machinery into a
+gated rollout:
+
+* ``start(variables)`` STAGES generation N+1 (:meth:`stage_weights` —
+  resident and pinnable, but NOT current; default traffic keeps hitting
+  gen N untouched).
+
+* The batcher asks :meth:`pick_generation` per batch; a
+  ``DPTPU_SERVE_CANARY_FRACTION`` slice of batches pins the canary
+  generation. The pin is taken INSIDE the canary lock so a concurrent
+  rollback can never hand out a generation it just discarded.
+
+* Every canary batch is SHADOW-EVALUATED: the batcher snapshots the
+  input rows before the staging lease recycles them, and the evaluator
+  thread (``dptpu-serve-canary``) replays them through the BASELINE
+  generation. ``max|Δlogit|`` above ``DPTPU_SERVE_CANARY_DRIFT`` means
+  the new weights disagree with the old beyond numerical noise —
+  **auto-rollback**. A canary batch-latency EWMA above
+  ``DPTPU_SERVE_CANARY_LAT_FACTOR`` × baseline rolls back too.
+
+* Rollback is LOUD (stderr + ``Serve/canary_rollbacks`` counter) and
+  clean: :meth:`discard_staged` drops the stager's pin, in-flight
+  canary batches drain on their pinned generation (the mixed-generation
+  -impossible property ``swap_weights`` already guarantees), and no
+  response is ever computed from a half-installed state.
+
+* After ``min_batches`` clean shadow evals the canary PROMOTES
+  (:meth:`promote` makes it current; gen N drains away).
+
+The injected ``canary_drift`` fault (``DPTPU_FAULT=canary_drift``)
+perturbs the staged weights at ``start`` so SERVEBENCH can prove the
+gate fires; the perturbation lives HERE (jax-side) to keep
+``dptpu.resilience.faults`` stdlib-only.
+
+Lock order: ``serve.canary`` (rank 18) sits between admission (15) and
+the engine (20) — pick/rollback/promote call into the engine while
+holding the canary lock.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from dptpu import obs
+from dptpu.utils.sync import OrderedLock
+
+
+class CanaryController:
+    """Gated rollout of one staged generation on one engine."""
+
+    def __init__(self, engine, *, fraction: float = 0.1,
+                 drift_limit: float = 50.0, lat_factor: float = 5.0,
+                 min_batches: int = 8, fault_plan=None):
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(
+                f"canary fraction {fraction} must be in (0, 1)"
+            )
+        self.engine = engine
+        self.fraction = fraction
+        self.drift_limit = drift_limit
+        self.lat_factor = lat_factor
+        self.min_batches = min_batches
+        self._plan = fault_plan
+        self._lock = OrderedLock("serve.canary")
+        self._state = "idle"  # guarded-by: _lock
+        self._canary_gen: Optional[int] = None  # guarded-by: _lock
+        self._base_gen: Optional[int] = None  # guarded-by: _lock
+        self._accum = 0.0  # guarded-by: _lock
+        self._canary_ms = 0.0  # guarded-by: _lock
+        self._base_ms = 0.0  # guarded-by: _lock
+        self._canary_batches = 0  # guarded-by: _lock
+        self._base_batches = 0  # guarded-by: _lock
+        self._clean_evals = 0  # guarded-by: _lock
+        self._max_drift = 0.0  # guarded-by: _lock
+        self._rollbacks = 0  # guarded-by: _lock
+        self._rollback_reason = ""  # guarded-by: _lock
+        self._q: queue.Queue = queue.Queue()
+        self._eval_thread = threading.Thread(
+            target=self._eval_loop, name="dptpu-serve-canary",
+            daemon=True,
+        )
+        self._eval_thread.start()
+
+    # -- rollout lifecycle ----------------------------------------------
+
+    def start(self, variables) -> int:
+        """Stage ``variables`` as the canary generation and begin
+        routing a traffic fraction at it. Returns the staged id."""
+        if self._plan is not None and self._plan.canary_drift_armed():
+            # injected drift: shift every parameter so the shadow eval
+            # MUST trip the gate (the fault-injection proof)
+            import jax
+            variables = dict(variables)
+            variables["params"] = jax.tree_util.tree_map(
+                lambda p: p + 3.0, variables["params"]
+            )
+        base = self.engine.current_generation
+        gen = self.engine.stage_weights(variables)
+        with self._lock:
+            if self._state == "canary":
+                # a rollout is already live: discard the new stage
+                self.engine.discard_staged(gen)
+                raise RuntimeError(
+                    "a canary rollout is already in progress"
+                )
+            self._state = "canary"
+            self._canary_gen = gen
+            self._base_gen = base
+            self._accum = 0.0
+            self._canary_ms = 0.0
+            self._base_ms = 0.0
+            self._canary_batches = 0
+            self._base_batches = 0
+            self._clean_evals = 0
+            self._max_drift = 0.0
+            self._rollback_reason = ""
+        return gen
+
+    def pick_generation(self) -> int:
+        """Choose + PIN the generation for one batch (the batcher calls
+        this instead of ``engine.acquire_generation()``). The engine pin
+        happens inside the canary lock so the chosen generation cannot
+        be discarded between the decision and the pin."""
+        with self._lock:
+            if self._state != "canary":
+                return self.engine.acquire_generation()
+            self._accum += self.fraction
+            if self._accum >= 1.0:
+                self._accum -= 1.0
+                return self.engine.acquire_generation(self._canary_gen)
+            return self.engine.acquire_generation(self._base_gen)
+
+    def wants_shadow(self, gen: int) -> bool:
+        """True when a batch pinned to ``gen`` must snapshot its input
+        rows for baseline replay (canary batches only)."""
+        with self._lock:
+            return self._state == "canary" and gen == self._canary_gen
+
+    def observe(self, gen: int, bucket: int, n: int, device_ms: float,
+                shadow, logits) -> None:
+        """Batcher callback after every completed batch: feeds the
+        latency gate and enqueues canary batches for shadow eval."""
+        with self._lock:
+            if self._state != "canary":
+                return
+            if gen == self._base_gen:
+                self._base_batches += 1
+                self._base_ms += 0.3 * (device_ms - self._base_ms) \
+                    if self._base_batches > 1 else device_ms
+                return
+            if gen != self._canary_gen:
+                return
+            self._canary_batches += 1
+            self._canary_ms += 0.3 * (device_ms - self._canary_ms) \
+                if self._canary_batches > 1 else device_ms
+            if (self._canary_batches >= 3 and self._base_batches >= 3
+                    and self._canary_ms >
+                    self.lat_factor * self._base_ms):
+                self._rollback_locked(
+                    f"canary batch latency {self._canary_ms:.1f} ms > "
+                    f"{self.lat_factor}x baseline {self._base_ms:.1f} ms"
+                )
+                return
+            if shadow is not None:
+                self._q.put((gen, bucket, n, shadow, np.array(logits)))
+
+    # -- shadow evaluation ----------------------------------------------
+
+    def _eval_loop(self):
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:
+                    return
+                self._eval_one(*job)
+            except Exception as e:
+                # the evaluator must survive a bad job: a dead evaluator
+                # silently disables the drift gate
+                print(f"=> serve canary shadow eval failed: {e}",
+                      file=sys.stderr, flush=True)
+            finally:
+                self._q.task_done()
+
+    def _eval_one(self, gen, bucket, n, shadow, canary_logits):
+        with self._lock:
+            if self._state != "canary" or gen != self._canary_gen:
+                return
+            base_gen = self._base_gen
+        try:
+            pin = self.engine.acquire_generation(base_gen)
+        except KeyError:
+            return  # baseline drained (promotion landed)
+        try:
+            base_logits = self.engine.run_bucket(
+                bucket, shadow, n, gen=pin
+            )
+        finally:
+            self.engine.release_generation(pin)
+        drift = float(np.max(np.abs(
+            base_logits[:n] - canary_logits[:n]
+        )))
+        with self._lock:
+            if self._state != "canary" or gen != self._canary_gen:
+                return
+            if drift > self._max_drift:
+                self._max_drift = drift
+            if drift > self.drift_limit:
+                self._rollback_locked(
+                    f"logit drift {drift:.3g} > limit "
+                    f"{self.drift_limit:.3g}"
+                )
+                return
+            self._clean_evals += 1
+            self._maybe_promote_locked()
+
+    # -- verdicts (call with _lock held) --------------------------------
+
+    def _rollback_locked(self, reason: str):
+        gen = self._canary_gen
+        self._state = "rolled_back"
+        self._rollbacks += 1
+        self._rollback_reason = reason
+        print(
+            f"=> serve canary ROLLED BACK (gen {gen}): {reason}",
+            file=sys.stderr, flush=True,
+        )
+        obs.get_registry().counter("Serve/canary_rollbacks").inc()
+        # drop the stager's pin: in-flight canary batches drain on their
+        # own pins, then the generation's buffers free (18 -> 20 nests)
+        self.engine.discard_staged(gen)
+
+    def _maybe_promote_locked(self):
+        if (self._clean_evals >= self.min_batches
+                and self._canary_batches >= self.min_batches):
+            self.engine.promote(self._canary_gen)
+            self._state = "promoted"
+            print(
+                f"=> serve canary PROMOTED (gen {self._canary_gen}): "
+                f"{self._clean_evals} clean shadow evals, max drift "
+                f"{self._max_drift:.3g}",
+                file=sys.stderr, flush=True,
+            )
+
+    # -- introspection / lifecycle --------------------------------------
+
+    @property
+    def rolling_back(self) -> bool:
+        """True during the rollback WINDOW: the verdict landed but
+        canary-pinned batches are still draining (the staged generation
+        is still resident). Readiness goes false here — a fleet router
+        must not route to a host mid-rollback."""
+        with self._lock:
+            if self._state != "rolled_back":
+                return False
+            return self._canary_gen in self.engine.generations()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "canary_gen": self._canary_gen,
+                "base_gen": self._base_gen,
+                "fraction": self.fraction,
+                "canary_batches": self._canary_batches,
+                "base_batches": self._base_batches,
+                "clean_evals": self._clean_evals,
+                "max_drift": self._max_drift,
+                "canary_ms": self._canary_ms,
+                "base_ms": self._base_ms,
+                "rollbacks": self._rollbacks,
+                "rollback_reason": self._rollback_reason,
+                "pending_evals": self._q.qsize(),
+            }
+
+    def drain_evals(self, timeout: float = 10.0) -> None:
+        """Block until every enqueued shadow eval has been PROCESSED
+        (tests and the bench use this to make verdicts deterministic)."""
+        t0 = time.perf_counter()
+        while self._q.unfinished_tasks:
+            if time.perf_counter() - t0 > timeout:
+                raise TimeoutError("shadow evals still pending")
+            time.sleep(0.005)
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._q.put(None)  # sentinel: wakes the evaluator to exit
+        self._eval_thread.join(timeout)

@@ -181,9 +181,13 @@ class ServeEngine:
             self._out_sharding = rep
 
         # generation store: {gen: device-placed variables}; a dispatched
-        # batch pins its generation until its logits materialize
+        # batch pins its generation until its logits materialize.
+        # _gen is the CURRENT (default-served) generation; _latest is the
+        # id counter — they diverge while a canary generation is staged
+        # (resident + pinned by its controller, but not current)
         self._lock = OrderedLock("serve.engine")
         self._gen = 1  # guarded-by: _lock
+        self._latest = 1  # guarded-by: _lock
         self._weights: Dict[int, dict] = {1: self._place(variables)}  # guarded-by: _lock
         self._inflight: Dict[int, int] = {1: 0}  # guarded-by: _lock
 
@@ -268,18 +272,67 @@ class ServeEngine:
                      "batch_stats": variables.get("batch_stats", {})}
         placed = self._place(variables)  # off-lock: device transfer
         with self._lock:
-            self._gen += 1
+            self._latest += 1
+            self._gen = self._latest
             self._weights[self._gen] = placed
             self._inflight[self._gen] = 0
             self._drop_drained_locked()
             return self._gen
 
-    def acquire_generation(self) -> int:
-        """Pin the CURRENT generation for one batch; the batch is served
-        with this generation's weights no matter what swaps land while
-        it is in flight."""
+    def stage_weights(self, variables) -> int:
+        """Install a new generation WITHOUT making it current (the
+        canary rollout's first half): the generation is resident and
+        pinnable via ``acquire_generation(gen=...)``, but default
+        traffic keeps serving the current one. The staged generation
+        starts with ONE in-flight pin — the stager's — so draining
+        cannot drop it before ``promote`` or ``discard_staged`` decides
+        its fate. Returns the staged id."""
+        variables = {"params": variables["params"],
+                     "batch_stats": variables.get("batch_stats", {})}
+        placed = self._place(variables)  # off-lock: device transfer
         with self._lock:
-            gen = self._gen
+            self._latest += 1
+            gen = self._latest
+            self._weights[gen] = placed
+            self._inflight[gen] = 1  # the stager's pin
+            return gen
+
+    def promote(self, gen: int) -> None:
+        """Make a staged generation CURRENT (the canary rollout's happy
+        ending) and release the stager's pin; the superseded generation
+        drains away exactly like a ``swap_weights`` predecessor."""
+        with self._lock:
+            if gen not in self._weights:
+                raise KeyError(f"generation {gen} is not resident")
+            if gen == self._gen:
+                return
+            self._gen = gen
+            self._inflight[gen] -= 1
+            self._drop_drained_locked()
+
+    def discard_staged(self, gen: int) -> None:
+        """Release the stager's pin WITHOUT promoting (canary rollback):
+        the staged generation's buffers drop the moment its last
+        in-flight canary batch releases."""
+        with self._lock:
+            if gen not in self._weights or gen == self._gen:
+                return  # already dropped, or promoted out from under us
+            self._inflight[gen] -= 1
+            self._drop_drained_locked()
+
+    def acquire_generation(self, gen: Optional[int] = None) -> int:
+        """Pin a generation for one batch (default: the CURRENT one;
+        a canary controller pins its staged id explicitly); the batch is
+        served with this generation's weights no matter what swaps land
+        while it is in flight."""
+        with self._lock:
+            if gen is None:
+                gen = self._gen
+            elif gen not in self._weights:
+                raise KeyError(
+                    f"generation {gen} is not resident (live: "
+                    f"{sorted(self._weights)})"
+                )
             self._inflight[gen] += 1
             return gen
 

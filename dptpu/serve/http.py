@@ -1,64 +1,147 @@
-"""Minimal stdlib HTTP front end over the batcher (the ``dptpu serve``
-subcommand's listener — no web framework in this environment, and none
-needed: the threading server's one-thread-per-connection model is
-exactly the batcher's submission model, where the caller's thread does
-the request's preprocessing).
+"""Minimal stdlib HTTP front end over the model router (the ``dptpu
+serve`` subcommand's listener — no web framework in this environment,
+and none needed: the threading server's one-thread-per-connection model
+is exactly the batcher's submission model, where the caller's thread
+does the request's preprocessing).
 
 Endpoints:
 
-* ``POST /predict`` — body = image bytes (any PIL-decodable container);
-  response = JSON ``{"top5": [[class_index, logit], ...],
-  "generation": g, "timings": {...}}``. Undecodable bytes → 400.
-* ``GET /healthz`` — liveness + the engine's arch/bucket ladder.
-* ``GET /metrics`` — the obs registry's flat scalar snapshot plus the
-  batcher's aggregate stats (``Serve/*`` group included).
+* ``POST /predict`` (default model) / ``POST /predict/<model>`` — body
+  = image bytes (any PIL-decodable container); response = JSON
+  ``{"top5": [[class_index, logit], ...], "model": m, "generation": g,
+  "timings": {...}}``. Undecodable bytes → 400; unknown model → 404.
+  Optional headers: ``X-DPTPU-Priority: high|normal|low`` and
+  ``X-DPTPU-Deadline-Ms: <float>`` (relative budget). Admission sheds
+  with **503** + ``Retry-After`` (saturated) or **429** (infeasible
+  deadline); an expired deadline answers **504**.
+* ``GET /healthz`` — LIVENESS only: the process is up and the engines
+  exist. Always 200 while the server can answer at all.
+* ``GET /readyz`` — READINESS: 200 only when every model can take
+  normal-priority traffic; 503 with the reasons (draining / shedding /
+  mid-rollback) so a fleet router pulls the host without killing
+  in-flight work.
+* ``GET /metrics`` — the obs registry's flat scalar snapshot plus
+  per-model batcher/admission/canary stats.
+
+Client-disconnect hygiene: if the peer drops mid-request the handler
+CANCELS the future — a still-coalescing request is evicted, its staging
+row is compacted away, and the admission ticket releases via the
+done-callback, so a dropped connection can never strand a leased row
+(the conftest lease-leak guard polices exactly that).
 """
 
 from __future__ import annotations
 
 import json
+import select
+import socket
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from dptpu.serve.admission import AdmissionError
+from dptpu.serve.batcher import DeadlineExceeded
 
-def make_handler(batcher):
-    engine = batcher.engine
+PRIORITY_HEADER = "X-DPTPU-Priority"
+DEADLINE_HEADER = "X-DPTPU-Deadline-Ms"
 
+
+def make_handler(router):
     class Handler(BaseHTTPRequestHandler):
-        server_version = "dptpu-serve/1"
+        server_version = "dptpu-serve/2"
 
-        def _send(self, code: int, payload: dict):
+        def _send(self, code: int, payload: dict, headers=()):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def log_message(self, fmt, *args):  # quiet: obs carries telemetry
             pass
 
+        def _peer_gone(self) -> bool:
+            """True when the client hung up: the socket is readable and
+            a peek returns EOF (pipelined request bytes are NOT EOF)."""
+            try:
+                r, _, _ = select.select([self.connection], [], [], 0)
+                if not r:
+                    return False
+                return self.connection.recv(1, socket.MSG_PEEK) == b""
+            except OSError:
+                return True
+
+        def _await(self, fut, timeout: float = 60.0):
+            """Wait for the future while WATCHING the socket: a client
+            that hangs up mid-wait gets its request CANCELLED — the
+            still-coalescing row is evicted instead of riding the batch
+            for a reader that no longer exists."""
+            t0 = time.monotonic()
+            while True:
+                try:
+                    return fut.result(timeout=0.25)
+                except TimeoutError:
+                    if time.monotonic() - t0 >= timeout:
+                        raise
+                    if self._peer_gone():
+                        fut.cancel()
+                        raise ConnectionResetError(
+                            "client disconnected mid-request"
+                        )
+
         def do_GET(self):
             if self.path == "/healthz":
+                # liveness: the process is up; per-model identity only
                 self._send(200, {
-                    "ok": True, "arch": engine.arch,
-                    "buckets": list(engine.buckets),
-                    "placement": engine.placement,
-                    "generation": engine.current_generation,
+                    "ok": True,
+                    "models": {
+                        name: {
+                            "arch": m.engine.arch,
+                            "buckets": list(m.engine.buckets),
+                            "placement": m.engine.placement,
+                            "generation": m.engine.current_generation,
+                        }
+                        for name, m in router.models.items()
+                    },
                 })
+            elif self.path == "/readyz":
+                ready, reasons = router.readiness()
+                self._send(200 if ready else 503,
+                           {"ready": ready, "reasons": reasons})
             elif self.path == "/metrics":
                 from dptpu import obs
 
                 self._send(200, {
                     "registry": obs.get_registry().scalars(),
-                    "serve": batcher.stats(reset_window=False),
+                    "models": router.stats(),
                 })
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path != "/predict":
+            if self.path == "/predict":
+                model = None
+            elif self.path.startswith("/predict/"):
+                model = self.path[len("/predict/"):]
+            else:
                 self._send(404, {"error": f"no route {self.path}"})
                 return
+            priority = self.headers.get(PRIORITY_HEADER, "normal")
+            raw_deadline = self.headers.get(DEADLINE_HEADER)
+            deadline_ms = None
+            if raw_deadline is not None:
+                try:
+                    deadline_ms = float(raw_deadline)
+                    if deadline_ms <= 0:
+                        raise ValueError
+                except ValueError:
+                    self._send(400, {
+                        "error": f"{DEADLINE_HEADER}={raw_deadline!r} "
+                                 f"is not a positive millisecond budget"
+                    })
+                    return
             try:
                 length = int(self.headers.get("Content-Length", 0))
             except ValueError:
@@ -67,31 +150,67 @@ def make_handler(batcher):
             if not 0 < length <= 64 << 20:
                 self._send(400, {"error": "missing or oversized body"})
                 return
-            data = self.rfile.read(length)
+            fut = None
             try:
-                fut = batcher.submit_bytes(data)
-                logits = fut.result(timeout=60.0)
+                data = self.rfile.read(length)
+                fut = router.submit(
+                    data=data, model=model, priority=priority,
+                    deadline_ms=deadline_ms,
+                )
+                logits = self._await(fut)
+            except AdmissionError as e:
+                headers = []
+                if e.retry_after_s:
+                    headers.append(
+                        ("Retry-After", f"{e.retry_after_s:.3f}")
+                    )
+                self._send(e.status, {"error": str(e)}, headers)
+                return
+            except DeadlineExceeded as e:
+                self._send(504, {"error": str(e)})
+                return
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+                return
             except ValueError as e:
                 self._send(400, {"error": str(e)})
                 return
+            except TimeoutError as e:
+                # backstop: never leave a leased row pinned by a future
+                # nobody will wait on again
+                fut.cancel()
+                self._send(504, {"error": str(e)})
+                return
+            except (BrokenPipeError, ConnectionResetError):
+                # client vanished while we read its body: withdraw the
+                # request so its row never reaches a bucket
+                if fut is not None:
+                    fut.cancel()
+                raise  # BaseHTTPRequestHandler closes the connection
             except Exception as e:
                 self._send(500, {"error": str(e)})
                 return
             top = logits.argsort()[::-1][:5]
-            self._send(200, {
-                "top5": [[int(i), float(logits[i])] for i in top],
-                "generation": fut.generation,
-                "timings": {k: round(v, 3) if isinstance(v, float) else v
-                            for k, v in fut.timings.items()},
-            })
+            try:
+                self._send(200, {
+                    "top5": [[int(i), float(logits[i])] for i in top],
+                    "model": model if model is not None else router.default,
+                    "generation": fut.generation,
+                    "timings": {
+                        k: round(v, 3) if isinstance(v, float) else v
+                        for k, v in fut.timings.items()
+                    },
+                })
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # answered into a closed socket; work already done
 
     return Handler
 
 
-def serve_forever(batcher, host: str = "127.0.0.1", port: int = 8000):
+def serve_forever(router, host: str = "127.0.0.1", port: int = 8000):
     """Blocking listener; Ctrl-C (or ``shutdown()`` from another thread)
-    returns, leaving batcher lifecycle to the caller."""
-    httpd = ThreadingHTTPServer((host, port), make_handler(batcher))
+    returns, leaving router lifecycle to the caller."""
+    httpd = ThreadingHTTPServer((host, port), make_handler(router))
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -19,6 +19,29 @@ Knobs:
 * ``DPTPU_SERVE_SLOTS`` — staging-ring depth in leased batch slots
   (default 4, >= 2: one filling + one in flight).
 
+Admission / robustness knobs (ISSUE 17):
+
+* ``DPTPU_SERVE_QUEUE_DEPTH`` — per-model admission bound: requests
+  admitted-but-unanswered beyond this are SHED with a fast 503 +
+  Retry-After instead of queueing (default 64, >= 1);
+* ``DPTPU_SERVE_PRIORITIES`` — shed thresholds for the three priority
+  classes (high,normal,low) as fractions of the queue depth, comma
+  list, each in (0, 1], non-increasing (high sheds LAST; default
+  ``1.0,0.85,0.6``);
+* ``DPTPU_SERVE_DEADLINE_MS`` — default per-request deadline applied
+  when a request names none (default 0 = no server-imposed deadline;
+  an expired request is evicted pre-dispatch and answered 504);
+* ``DPTPU_SERVE_CANARY_FRACTION`` — fraction of BATCHES routed to a
+  staged canary generation while a rollout is active (default 0.1,
+  in (0, 1) — batch-granular so one-generation-per-batch holds);
+* ``DPTPU_SERVE_CANARY_DRIFT`` — canary logit-drift gate: max|Δlogit|
+  vs the baseline generation on the same inputs above this triggers
+  auto-rollback (default 50.0, > 0 — catastrophic-weights scale, not
+  a retraining-noise scale);
+* ``DPTPU_SERVE_CANARY_LAT_FACTOR`` — canary latency gate: canary
+  batch device time above ``factor x`` the baseline's triggers
+  auto-rollback (default 5.0, > 1).
+
 Stdlib-only: the CLI validates pre-jax (a typo'd knob must fail before
 any compile), and the conftest leak guard imports the serve package.
 """
@@ -32,8 +55,15 @@ from dptpu.envknob import env_choice, env_float, env_int, env_str
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
 DEFAULT_MAX_DELAY_MS = 5.0
 DEFAULT_SLOTS = 4
+DEFAULT_QUEUE_DEPTH = 64
+DEFAULT_PRIORITIES: Tuple[float, ...] = (1.0, 0.85, 0.6)
+DEFAULT_DEADLINE_MS = 0.0  # 0 = no server-imposed default deadline
+DEFAULT_CANARY_FRACTION = 0.1
+DEFAULT_CANARY_DRIFT = 50.0
+DEFAULT_CANARY_LAT_FACTOR = 5.0
 
 PLACEMENTS = ("auto", "replicated", "tp")
+PRIORITY_NAMES = ("high", "normal", "low")
 
 
 class ServeKnobs(NamedTuple):
@@ -41,6 +71,12 @@ class ServeKnobs(NamedTuple):
     max_delay_ms: float
     placement: str
     slots: int
+    queue_depth: int
+    priorities: Tuple[float, ...]
+    deadline_ms: float
+    canary_fraction: float
+    canary_drift: float
+    canary_lat_factor: float
 
 
 def parse_buckets(raw, source: str = "DPTPU_SERVE_BUCKETS"
@@ -81,10 +117,54 @@ def parse_buckets(raw, source: str = "DPTPU_SERVE_BUCKETS"
     return buckets
 
 
+def parse_priorities(raw, source: str = "DPTPU_SERVE_PRIORITIES"
+                     ) -> Tuple[float, ...]:
+    """Validate the priority shed thresholds (comma string or float
+    sequence): one fraction of the queue depth per class
+    (high, normal, low), each in (0, 1], non-increasing — high priority
+    must shed LAST, so an increasing ladder is a config bug, not a
+    creative policy."""
+    if isinstance(raw, str):
+        parts = [p.strip() for p in raw.split(",") if p.strip()]
+        try:
+            fracs = tuple(float(p) for p in parts)
+        except ValueError:
+            raise ValueError(
+                f"{source}={raw!r} is not a comma list of fractions "
+                f"(expected e.g. {source}=1.0,0.85,0.6)"
+            ) from None
+    else:
+        fracs = tuple(float(f) for f in raw)
+    if len(fracs) != len(PRIORITY_NAMES):
+        raise ValueError(
+            f"{source}={raw!r} needs exactly {len(PRIORITY_NAMES)} "
+            f"thresholds, one per priority class "
+            f"({','.join(PRIORITY_NAMES)})"
+        )
+    if any(not 0.0 < f <= 1.0 for f in fracs):
+        raise ValueError(
+            f"{source}={','.join(map(str, fracs))}: every threshold "
+            f"must be a fraction of the queue depth in (0, 1]"
+        )
+    if any(a < b for a, b in zip(fracs, fracs[1:])):
+        raise ValueError(
+            f"{source}={','.join(map(str, fracs))}: thresholds must be "
+            f"non-increasing from high to low (high priority sheds "
+            f"last, so its threshold is the largest)"
+        )
+    return fracs
+
+
 def serve_knobs(buckets: Optional[Sequence[int]] = None,
                 max_delay_ms: Optional[float] = None,
                 placement: Optional[str] = None,
                 slots: Optional[int] = None,
+                queue_depth: Optional[int] = None,
+                priorities: Optional[Sequence[float]] = None,
+                deadline_ms: Optional[float] = None,
+                canary_fraction: Optional[float] = None,
+                canary_drift: Optional[float] = None,
+                canary_lat_factor: Optional[float] = None,
                 environ=None) -> ServeKnobs:
     """Resolve + validate the serve knobs. Arguments are the CLI/config
     values (None = not given); the env twins override them when set; the
@@ -134,4 +214,80 @@ def serve_knobs(buckets: Optional[Sequence[int]] = None,
             f"{source}={n_slots} must be >= 2 staging slots (one "
             f"filling while one is leased to the device)"
         )
-    return ServeKnobs(out_buckets, float(delay), place, int(n_slots))
+
+    depth = env_int("DPTPU_SERVE_QUEUE_DEPTH", None, environ=env)
+    source = "DPTPU_SERVE_QUEUE_DEPTH"
+    if depth is None:
+        depth, source = queue_depth, "--queue-depth"
+    if depth is None:
+        depth = DEFAULT_QUEUE_DEPTH
+    if depth < 1:
+        raise ValueError(
+            f"{source}={depth} must be >= 1 admitted-but-unanswered "
+            f"request (the bound past which admission sheds with "
+            f"503 + Retry-After instead of queueing)"
+        )
+
+    raw_prios = env_str("DPTPU_SERVE_PRIORITIES", "", environ=env)
+    if raw_prios:
+        out_prios = parse_priorities(raw_prios)
+    elif priorities is not None:
+        out_prios = parse_priorities(priorities, source="--priorities")
+    else:
+        out_prios = DEFAULT_PRIORITIES
+
+    dl = env_float("DPTPU_SERVE_DEADLINE_MS", None, environ=env)
+    source = "DPTPU_SERVE_DEADLINE_MS"
+    if dl is None:
+        dl, source = deadline_ms, "--deadline-ms"
+    if dl is None:
+        dl = DEFAULT_DEADLINE_MS
+    if dl < 0:
+        raise ValueError(
+            f"{source}={dl} must be >= 0 ms (0 = no server-imposed "
+            f"default deadline; requests may still name their own)"
+        )
+
+    frac = env_float("DPTPU_SERVE_CANARY_FRACTION", None, environ=env)
+    source = "DPTPU_SERVE_CANARY_FRACTION"
+    if frac is None:
+        frac, source = canary_fraction, "--canary-fraction"
+    if frac is None:
+        frac = DEFAULT_CANARY_FRACTION
+    if not 0.0 < frac < 1.0:
+        raise ValueError(
+            f"{source}={frac} must be a fraction in (0, 1) — the share "
+            f"of batches routed to a staged canary generation (1.0 "
+            f"would be a full cutover, which is swap_weights, not a "
+            f"canary)"
+        )
+
+    drift = env_float("DPTPU_SERVE_CANARY_DRIFT", None, environ=env)
+    source = "DPTPU_SERVE_CANARY_DRIFT"
+    if drift is None:
+        drift, source = canary_drift, "--canary-drift"
+    if drift is None:
+        drift = DEFAULT_CANARY_DRIFT
+    if drift <= 0:
+        raise ValueError(
+            f"{source}={drift} must be > 0 (max|Δlogit| vs the baseline "
+            f"generation tolerated before auto-rollback; 0 would "
+            f"roll back every real weight change)"
+        )
+
+    lat = env_float("DPTPU_SERVE_CANARY_LAT_FACTOR", None, environ=env)
+    source = "DPTPU_SERVE_CANARY_LAT_FACTOR"
+    if lat is None:
+        lat, source = canary_lat_factor, "--canary-lat-factor"
+    if lat is None:
+        lat = DEFAULT_CANARY_LAT_FACTOR
+    if lat <= 1.0:
+        raise ValueError(
+            f"{source}={lat} must be > 1 (canary batch latency above "
+            f"factor x the baseline's triggers auto-rollback; <= 1 "
+            f"would roll back on measurement noise)"
+        )
+
+    return ServeKnobs(out_buckets, float(delay), place, int(n_slots),
+                      int(depth), out_prios, float(dl), float(frac),
+                      float(drift), float(lat))

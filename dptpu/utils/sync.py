@@ -61,8 +61,14 @@ def sync_check_enabled(environ=None) -> bool:
 # nested ``with`` scopes that invert these ranks.
 LOCK_RANKS = {
     # serve: the batcher's dispatcher/submitter seam is outermost (it
-    # calls into the engine, the histogram and the tracer while running)
+    # calls into the engine, the histogram and the tracer while running).
+    # Admission sits ABOVE the batcher because occupancy releases run in
+    # future done-callbacks fired under the batcher's condition; the
+    # canary controller sits between admission and the engine because
+    # pick/rollback/promote pin generations while holding its lock.
     "serve.batcher": 10,
+    "serve.admission": 15,
+    "serve.canary": 18,
     "serve.engine": 20,
     # train: the async checkpoint writer's error seam
     "train.ckpt_writer": 30,

@@ -200,6 +200,304 @@ def measure_preprocess(image_size, reps=20):
     return (time.perf_counter() - t0) / reps * 1e3
 
 
+# -- robustness arms (ISSUE 17) ------------------------------------------
+
+
+def overload_shedding_arm(engine, knobs, pool, saturation_qps, n_requests,
+                          budget_ms, seed=7):
+    """Offer 2x the measured saturation THROUGH the admission gate:
+    the p99 of ADMITTED requests must stay bounded (occupancy is capped,
+    so queueing cannot diverge) and every shed decision must land in
+    well under a service time (the whole point of shedding over
+    blocking)."""
+    from dptpu.obs.metrics import _quantile
+    from dptpu.serve import DynamicBatcher
+    from dptpu.serve.admission import AdmissionController, AdmissionError
+
+    b = DynamicBatcher(engine, max_delay_ms=knobs.max_delay_ms,
+                       slots=knobs.slots)
+    # depth below the ring's row capacity: admission must shed BEFORE
+    # the ring's blocking backpressure would stall the arrival clock
+    depth = max(4, knobs.slots * engine.exec_batch(engine.max_bucket) // 2)
+    adm = AdmissionController(depth=depth, priorities=knobs.priorities,
+                              name="overload")
+    try:
+        b.submit_array(pool[0]).result(timeout=300)  # warm
+        offered = 2.0 * max(saturation_qps, 1.0)
+        gaps = np.random.RandomState(seed).exponential(
+            1.0 / offered, size=n_requests)
+        admitted, shed_ms = [], []
+        t_next = time.perf_counter()
+        for i in range(n_requests):
+            t_next += gaps[i]
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t_a = time.perf_counter()
+            try:
+                ticket = adm.try_admit("normal")
+            except AdmissionError:
+                shed_ms.append((time.perf_counter() - t_a) * 1e3)
+                continue
+
+            def _rel(f, _t=ticket, _a=adm):
+                _a.release(_t, service_ms=f.timings.get("total_ms"))
+
+            fut = b.submit_array(pool[i % len(pool)])
+            fut.add_done_callback(_rel)
+            admitted.append(fut)
+        for f in admitted:
+            f.result(timeout=300)
+        lats = sorted(f.timings["total_ms"] for f in admitted)
+        p50 = _quantile(lats, 0.50)
+        p99 = _quantile(lats, 0.99)
+        shed_p99 = _quantile(sorted(shed_ms), 0.99) if shed_ms else 0.0
+        return {
+            "offered_qps": round(offered, 2),
+            "admission_depth": depth,
+            "admitted": len(admitted),
+            "shed": len(shed_ms),
+            "admitted_p50_ms": round(p50, 2),
+            "admitted_p99_ms": round(p99, 2),
+            "admitted_p99_budget_ms": round(budget_ms, 1),
+            "shed_decision_p99_ms": round(shed_p99, 4),
+            "admission_stats": adm.stats(),
+            "ok": bool(
+                shed_ms
+                and p99 <= budget_ms
+                and shed_p99 < p50  # reject in < p50 of service time
+            ),
+        }
+    finally:
+        b.close()
+
+
+def multi_model_arm(engine_a, knobs, pool, arch, image_size, num_classes,
+                    n_requests):
+    """Two co-resident engines on one host's device budget, concurrent
+    closed-loop load on both, per-model p99s on record — a saturated
+    neighbour must not take the other model down."""
+    from dptpu.serve import ServeEngine
+
+    engine_b = ServeEngine(arch, buckets=(1, 4), num_classes=num_classes,
+                           image_size=image_size)
+    results, errs = {}, []
+
+    def run(name, engine):
+        try:
+            results[name] = closed_loop_point(engine, knobs, pool, 2,
+                                              n_requests)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append((name, e))
+
+    threads = [threading.Thread(target=run, args=("a", engine_a)),
+               threading.Thread(target=run, args=("b", engine_b))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise RuntimeError(f"multi-model client failed: {errs[0]}")
+    return {
+        "models": {
+            name: {k: p[k] for k in
+                   ("requests", "achieved_qps", "p50_ms", "p99_ms")}
+            for name, p in results.items()
+        },
+        "ok": all(p["requests"] == n_requests for p in results.values()),
+    }
+
+
+def canary_rollback_arm(engine, knobs, pool, n_requests=40):
+    """Injected ``canary_drift``: stage bit-identical weights that the
+    fault perturbs, prove the shadow-eval gate rolls the canary back,
+    and that no response was ever computed from a mixed or discarded
+    generation."""
+    import jax.tree_util as jtu
+
+    from dptpu.resilience.faults import FaultPlan
+    from dptpu.serve import DynamicBatcher
+    from dptpu.serve.canary import CanaryController
+
+    plan = FaultPlan("canary_drift")
+    canary = CanaryController(engine, fraction=0.5,
+                              drift_limit=knobs.canary_drift,
+                              lat_factor=knobs.canary_lat_factor,
+                              fault_plan=plan)
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=knobs.slots,
+                       canary=canary)
+    try:
+        base = engine.current_generation
+        weights = jtu.tree_map(lambda x: np.array(x),
+                               engine._weights[base])
+        gen = canary.start(weights)
+        mixed = served = 0
+        for i in range(n_requests):
+            f = b.submit_array(pool[i % len(pool)])
+            f.result(timeout=300)
+            served += 1
+            if f.generation not in (base, gen):
+                mixed += 1
+            canary.drain_evals(timeout=60)
+            if canary.status()["state"] == "rolled_back":
+                break
+        st = canary.status()
+        post = b.submit_array(pool[0])
+        post.result(timeout=300)
+        return {
+            "injected_fault": "canary_drift",
+            "requests_served": served,
+            "state": st["state"],
+            "rollbacks": st["rollbacks"],
+            "rollback_reason": st["rollback_reason"],
+            # an all-params perturbation can push logits to inf; keep
+            # the artifact strict-JSON by stringifying non-finite drift
+            "max_drift": round(st["max_drift"], 3)
+            if np.isfinite(st["max_drift"]) else str(st["max_drift"]),
+            "drift_limit": knobs.canary_drift,
+            "mixed_generation_responses": mixed,
+            "post_rollback_serves_base": post.generation == base,
+            "ok": bool(st["state"] == "rolled_back"
+                       and st["rollbacks"] == 1
+                       and mixed == 0
+                       and post.generation == base),
+        }
+    finally:
+        b.close()
+        canary.close()
+
+
+def dead_request_hygiene_arm(engine, knobs, pool):
+    """Submit 6 into one coalescing batch, cancel 4: the batch must
+    execute at the LIVE count's bucket — the padding-waste accounting
+    proves the dead rows occupied zero bucket rows."""
+    from dptpu.serve import DynamicBatcher
+
+    b = DynamicBatcher(engine, max_delay_ms=10_000.0, slots=knobs.slots)
+    futs = [b.submit_array(pool[i]) for i in range(6)]
+    for f in futs[:4]:
+        if not f.cancel():
+            raise RuntimeError("cancel refused pre-dispatch")
+    b.close(drain=True)  # closing dispatches the coalescing batch NOW
+    outs = [f.result(timeout=300) for f in futs[4:]]
+    s = b.stats()
+    live_bucket = engine.bucket_for(2)
+    exec_rows = engine.exec_batch(live_bucket)
+    claimed_bucket = engine.bucket_for(6)
+    waste = (exec_rows - 2) / exec_rows
+    return {
+        "submitted": 6,
+        "cancelled": 4,
+        "claimed_bucket": claimed_bucket,
+        "dispatched_bucket": futs[4].timings["bucket"],
+        "exec_rows": exec_rows,
+        "dead_rows": s["dead_rows"],
+        "padding_waste": round(s["padding_waste"], 3),
+        "ok": bool(
+            len(outs) == 2
+            and s["dead_rows"] == 4
+            and s["batches"] == 1
+            and futs[4].timings["bucket"] == live_bucket
+            and live_bucket < claimed_bucket
+            and abs(s["padding_waste"] - waste) < 1e-9
+        ),
+    }
+
+
+def serve_faults_arm(engine, knobs, pool):
+    """The serve-side DPTPU_FAULT grammar, each kind proven through the
+    real stack: an injected submit exception rejects ONE request, a
+    preprocess crash fails alone while its batchmates answer, and a
+    slow model is shed by admission instead of blocking the ring."""
+    from dptpu.resilience.faults import FaultPlan
+    from dptpu.serve import DynamicBatcher
+    from dptpu.serve.admission import AdmissionController, AdmissionError
+    from dptpu.serve.batcher import ServeError
+
+    results = {}
+
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=2,
+                       fault_plan=FaultPlan("serve_exception@request=2"))
+    try:
+        rejected = served = 0
+        for i in range(4):
+            try:
+                f = b.submit_array(pool[i])
+            except ServeError:
+                rejected += 1
+                continue
+            f.result(timeout=300)
+            served += 1
+        results["serve_exception"] = {
+            "rejected": rejected, "served": served,
+            "ok": rejected == 1 and served == 3,
+        }
+    finally:
+        b.close()
+
+    b = DynamicBatcher(engine, max_delay_ms=100.0, slots=2,
+                       fault_plan=FaultPlan("preprocess_crash@request=2"))
+    try:
+        futs = [b.submit_array(pool[i]) for i in range(4)]
+        failed = served = 0
+        for f in futs:
+            try:
+                f.result(timeout=300)
+                served += 1
+            except ServeError:
+                failed += 1
+        results["preprocess_crash"] = {
+            "failed": failed, "served": served,
+            "ok": failed == 1 and served == 3,
+        }
+    finally:
+        b.close()
+
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=2,
+                       fault_plan=FaultPlan("slow_model:factor=25"))
+    adm = AdmissionController(depth=4, name="slow")
+    try:
+        def _rel(f, _t, _a=adm):
+            _a.release(_t, service_ms=f.timings.get("total_ms"))
+
+        # two completions teach the EWMA how slow the model really is
+        for i in range(2):
+            t = adm.try_admit("normal")
+            f = b.submit_array(pool[i])
+            f.add_done_callback(lambda g, _t=t: _rel(g, _t))
+            f.result(timeout=300)
+        # burst without waiting: occupancy crosses the normal mark and
+        # sheds in microseconds while batches take a slow-model beat
+        shed, shed_ms, held = 0, [], []
+        for i in range(8):
+            t_a = time.perf_counter()
+            try:
+                t = adm.try_admit("normal")
+            except AdmissionError:
+                shed += 1
+                shed_ms.append((time.perf_counter() - t_a) * 1e3)
+                continue
+            f = b.submit_array(pool[i % len(pool)])
+            f.add_done_callback(lambda g, _t=t: _rel(g, _t))
+            held.append(f)
+        for f in held:
+            f.result(timeout=300)
+        ewma = adm.stats()["service_ewma_ms"]
+        results["slow_model"] = {
+            "factor": 25, "shed": shed,
+            "service_ewma_ms": round(ewma, 1),
+            "max_shed_decision_ms": round(max(shed_ms), 4) if shed_ms
+            else None,
+            "ok": shed > 0 and bool(shed_ms)
+            and max(shed_ms) < ewma,
+        }
+    finally:
+        b.close()
+
+    results["ok"] = all(v["ok"] for v in results.values())
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -288,12 +586,50 @@ def main():
         "parity_ok": max_dlogit == 0.0,
     }
 
+    # robustness arms (ISSUE 17): overload shedding, co-resident
+    # multi-model interference, canary auto-rollback, dead-request
+    # hygiene, and the serve-side fault grammar — same engine, same
+    # gates in smoke and full runs
+    shed = overload_shedding_arm(engine, knobs, pool, saturation_qps,
+                                 n_req, budget_ms=2 * tail_budget_ms)
+    print(f"overload 2x sat: {shed['admitted']} admitted / "
+          f"{shed['shed']} shed, admitted p99 {shed['admitted_p99_ms']}ms"
+          f" (budget {shed['admitted_p99_budget_ms']}ms), shed decision "
+          f"p99 {shed['shed_decision_p99_ms']}ms")
+    mm = multi_model_arm(engine, knobs, pool, args.arch, image_size,
+                         num_classes, max(n_req // 2, 10))
+    print(f"multi-model: " + ", ".join(
+        f"{name} p99 {p['p99_ms']}ms ({p['achieved_qps']} qps)"
+        for name, p in mm["models"].items()))
+    can = canary_rollback_arm(engine, knobs, pool)
+    print(f"canary: {can['state']} after {can['requests_served']} "
+          f"requests (drift {can['max_drift']} > {can['drift_limit']}), "
+          f"mixed-generation responses {can['mixed_generation_responses']}")
+    hyg = dead_request_hygiene_arm(engine, knobs, pool)
+    print(f"hygiene: 6 claimed / 4 cancelled -> bucket "
+          f"{hyg['dispatched_bucket']} (claimed-count bucket "
+          f"{hyg['claimed_bucket']}), padding_waste "
+          f"{hyg['padding_waste']}")
+    flt = serve_faults_arm(engine, knobs, pool)
+    print(f"serve faults: " + ", ".join(
+        f"{k}={'ok' if v['ok'] else 'FAIL'}"
+        for k, v in flt.items() if k != "ok"))
+    gates.update({
+        "shed_ok": shed["ok"],
+        "multi_model_ok": mm["ok"],
+        "canary_ok": can["ok"],
+        "hygiene_ok": hyg["ok"],
+        "faults_ok": flt["ok"],
+    })
+
     out = {
-        "round": 11,
+        "round": 12,
         "what": ("serve latency x offered load (closed + open loop), "
                  "saturation throughput, bucket utilization, tail + "
-                 "padded-parity gates, through "
-                 "ServeEngine+DynamicBatcher"),
+                 "padded-parity gates, plus the robustness arms — "
+                 "overload shedding, multi-model interference, canary "
+                 "auto-rollback, dead-request hygiene, serve faults — "
+                 "through ServeEngine+DynamicBatcher+admission"),
         "smoke": bool(args.smoke),
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
@@ -327,6 +663,13 @@ def main():
             "budget_ms": round(tail_budget_ms, 1),
             "factor": args.tail_factor,
             "floor_ms": args.tail_floor_ms,
+        },
+        "robustness": {
+            "overload_shedding": shed,
+            "multi_model": mm,
+            "canary_rollback": can,
+            "dead_request_hygiene": hyg,
+            "serve_faults": flt,
         },
         "gates": gates,
         "bench_wall_s": round(time.time() - t_bench, 1),

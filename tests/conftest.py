@@ -105,6 +105,11 @@ _FAST_MODULES = {
     # the racebench smoke is the seventh fit-shaped exception (one
     # subprocess, --smoke preset, same gates as RACEBENCH.json)
     "test_overlap", "test_racebench_smoke",
+    # robust serving tier (ISSUE 17): admission/canary/router units and
+    # the HTTP surface reuse the tiny resnet18@32 ladder (the test_serve
+    # precedent) — the shed/rollback/disconnect-hygiene acceptance bars
+    # MUST hold in tier 1
+    "test_serve_admission", "test_serve_http",
 }
 
 

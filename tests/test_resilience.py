@@ -76,10 +76,42 @@ def test_fault_spec_parses_all_kinds():
     "sigterm@tick=3",       # unknown modifier key
     "worker_hang@index=2@s=0",     # straggler sleep must be > 0
     "worker_hang@index=2@s=soon",  # non-numeric sleep
+    "serve_exception",      # missing required @request
+    "preprocess_crash",     # missing required @request
+    "serve_exception@request=0",   # request index is 1-based
+    "serve_exception@request=abc", # non-numeric request index
+    "slow_model",           # missing required :factor
+    "slow_model:factor=1",  # factor must be > 1
 ])
 def test_fault_spec_rejects_typos(bad):
     with pytest.raises(ValueError):
         FaultPlan(bad)
+
+
+def test_serve_fault_kinds_parse_and_fire():
+    p = FaultPlan("serve_exception@request=3,preprocess_crash@request=5,"
+                  "slow_model:factor=4,canary_drift")
+    assert [f.kind for f in p.faults] == [
+        "serve_exception", "preprocess_crash", "slow_model",
+        "canary_drift",
+    ]
+    # submit hook: fires ONCE at the matching 1-based index
+    p.on_serve_submit(1)
+    p.on_serve_submit(2)
+    with pytest.raises(RuntimeError, match="serve_exception on request 3"):
+        p.on_serve_submit(3)
+    p.on_serve_submit(3)  # fired flag: one-shot
+    # preprocess hook
+    p.on_serve_preprocess(4)
+    with pytest.raises(RuntimeError,
+                       match="preprocess_crash on request 5"):
+        p.on_serve_preprocess(5)
+    p.on_serve_preprocess(5)
+    # model delay: base x factor, summed over armed slow_model faults
+    assert p.serve_model_delay_s() == pytest.approx(0.02 * 4)
+    assert p.canary_drift_armed()
+    assert not FaultPlan("slow_model:factor=2").canary_drift_armed()
+    assert FaultPlan("canary_drift").serve_model_delay_s() == 0.0
 
 
 def test_worker_hang_straggler_modifiers(monkeypatch):

@@ -16,6 +16,7 @@
 
 import io
 import os
+import time
 
 import numpy as np
 import pytest
@@ -340,3 +341,131 @@ def test_staging_close_with_lease_counts_as_leak():
     serve_staging._LEASE_LEAKS = before
     if os.path.isdir("/dev/shm"):
         assert not os.path.exists(f"/dev/shm/{name}")  # unlinked
+
+
+# ------------------------------------------------- request lifecycle ----
+# ISSUE 17: deadlines, cancellation, and dead-request hygiene — a
+# cancelled/expired request must free its admission slot, stop anchoring
+# the coalescing timer, and occupy ZERO bucket rows at execution.
+
+
+def test_cancel_pre_dispatch_frees_rows(cnn_engine):
+    from dptpu.serve import ServeCancelled
+
+    b = DynamicBatcher(cnn_engine, max_delay_ms=400.0, slots=2)
+    try:
+        imgs = _rand_images(6, 32, seed=11)
+        futs = [b.submit_array(imgs[i]) for i in range(6)]
+        # withdraw 4 of 6 while the batch is still coalescing
+        for f in futs[1:5]:
+            assert f.cancel()
+        for f in futs[1:5]:
+            with pytest.raises(ServeCancelled):
+                f.result(timeout=5)
+            assert not f.cancel()  # already done: cancel() is False
+        r0 = futs[0].result(timeout=30)
+        r5 = futs[5].result(timeout=30)
+        # dead-request hygiene: 2 live rows execute at bucket 4 (claimed
+        # count 6 would have needed bucket 16)
+        assert futs[0].timings["bucket"] == 4
+        assert futs[5].timings["bucket"] == 4
+        # the two live requests still get THEIR pixels' logits: parity
+        # against a fresh batcher proves compaction moved the right rows
+        b2 = DynamicBatcher(cnn_engine, max_delay_ms=0.0, slots=2)
+        try:
+            want0 = b2.submit_array(imgs[0]).result(timeout=30)
+            want5 = b2.submit_array(imgs[5]).result(timeout=30)
+        finally:
+            b2.close()
+        np.testing.assert_array_equal(r0, want0)
+        np.testing.assert_array_equal(r5, want5)
+        s = b.stats()
+        assert s["cancelled"] == 4
+        assert s["dead_rows"] == 4
+        assert s["completed"] == 2
+    finally:
+        b.close()
+
+
+def test_cancel_whole_batch_abandons_slot(cnn_engine):
+    from dptpu.serve import ServeCancelled
+
+    b = DynamicBatcher(cnn_engine, max_delay_ms=400.0, slots=2)
+    try:
+        futs = [b.submit_array(_rand_images(1, 32, seed=i)[0])
+                for i in range(3)]
+        for f in futs:
+            f.cancel()
+        for f in futs:
+            with pytest.raises(ServeCancelled):
+                f.result(timeout=5)
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:
+            if b.stats(reset_window=False)["dead_rows"] == 3:
+                break
+            time.sleep(0.02)
+        s = b.stats()
+        assert s["dead_rows"] == 3 and s["batches"] == 0
+        # the slot was abandoned, not leaked: a new request still serves
+        out = b.submit_array(_rand_images(1, 32, seed=9)[0])
+        assert out.result(timeout=30).shape == (8,)
+    finally:
+        b.close()
+
+
+def test_deadline_evicted_while_coalescing(cnn_engine):
+    from dptpu.serve import DeadlineExceeded
+
+    b = DynamicBatcher(cnn_engine, max_delay_ms=5000.0, slots=2)
+    try:
+        img = _rand_images(1, 32, seed=3)[0]
+        fut = b.submit_array(img,
+                             deadline=time.perf_counter() + 0.05)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        s = b.stats()
+        assert s["expired"] == 1
+        assert s["completed"] == 0
+    finally:
+        b.close(drain=False)
+
+
+def test_cancel_after_dispatch_returns_false(cnn_engine):
+    b = DynamicBatcher(cnn_engine, max_delay_ms=0.0, slots=2)
+    try:
+        fut = b.submit_array(_rand_images(1, 32, seed=4)[0])
+        fut.result(timeout=30)
+        assert not fut.cancel()  # device work cannot be unclaimed
+    finally:
+        b.close()
+
+
+def test_timer_reanchors_to_oldest_live_request(cnn_engine):
+    """Cancelling the OLDEST request must re-anchor the max_delay_ms
+    coalescing timer onto the next-oldest LIVE request — the batch must
+    NOT dispatch at the dead request's (earlier) budget expiry."""
+    from dptpu.serve import ServeCancelled
+
+    delay_ms = 700.0
+    b = DynamicBatcher(cnn_engine, max_delay_ms=delay_ms, slots=2)
+    try:
+        old = b.submit_array(_rand_images(1, 32, seed=5)[0])
+        time.sleep(0.35)  # half the budget later...
+        young = b.submit_array(_rand_images(1, 32, seed=6)[0])
+        t_young = time.perf_counter()
+        old.cancel()
+        with pytest.raises(ServeCancelled):
+            old.result(timeout=5)
+        young.result(timeout=30)
+        served_after = time.perf_counter() - t_young
+        # anchored to the dead request, the batch would have gone out
+        # ~0.35 s after `young` arrived; re-anchored it waits the full
+        # budget from young's t_ready
+        assert served_after >= delay_ms / 1e3 - 0.05, (
+            f"dispatched {served_after:.3f}s after the live request — "
+            f"timer still anchored to the cancelled one"
+        )
+        s = b.stats()
+        assert s["cancelled"] == 1 and s["completed"] == 1
+    finally:
+        b.close()

@@ -1,0 +1,340 @@
+"""Admission / canary / router units (ISSUE 17).
+
+* :class:`AdmissionController` — bounded occupancy with priority water
+  marks (503 + ``Retry-After``), deadline feasibility (429), idempotent
+  release feeding the service-time EWMA;
+* :class:`CanaryController` — clean-weights promotion, genuine-drift
+  auto-rollback, the fabricated latency gate, double-start refusal;
+* :class:`ModelRouter` — named routes, the done-callback occupancy
+  release covering the whole request lifecycle, readiness reasons, and
+  ticket release on submit-path exceptions.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dptpu import obs
+from dptpu.serve import ServeEngine
+from dptpu.serve.admission import AdmissionController, AdmissionError
+from dptpu.serve.batcher import DynamicBatcher, ServeError
+from dptpu.serve.canary import CanaryController
+from dptpu.serve.knobs import ServeKnobs
+from dptpu.serve.router import ModelRouter, build_served_model
+
+
+def _rand_images(n, size, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, size, size, 3), np.uint8
+    )
+
+
+def _fresh_variables(engine, seed):
+    init = engine.model.init(
+        jax.random.PRNGKey(seed),
+        np.zeros((1, engine.image_size, engine.image_size, 3), np.float32),
+        train=False,
+    )
+    return {"params": init["params"],
+            "batch_stats": init.get("batch_stats", {})}
+
+
+def _clone_variables(engine):
+    """A bit-identical copy of the CURRENT generation's weights — stages
+    a canary whose logits provably cannot drift."""
+    import jax.tree_util as jtu
+    gen = engine.current_generation
+    return jtu.tree_map(lambda x: np.array(x), engine._weights[gen])
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ServeEngine("resnet18", buckets=(1, 4), num_classes=8,
+                       image_size=32)
+
+
+def _knobs(**over):
+    base = dict(
+        buckets=(1, 4), max_delay_ms=0.0, placement="auto", slots=2,
+        queue_depth=8, priorities=(1.0, 0.85, 0.6), deadline_ms=0.0,
+        canary_fraction=0.5, canary_drift=50.0, canary_lat_factor=5.0,
+    )
+    base.update(over)
+    return ServeKnobs(**base)
+
+
+# ---------------------------------------------------------- admission ----
+
+
+def test_admission_priority_water_marks():
+    a = AdmissionController(depth=4, name="m")
+    # thresholds: high=4, normal=3, low=2 (round(depth * frac), min 1)
+    assert a.thresholds == {"high": 4, "normal": 3, "low": 2}
+    t1 = a.try_admit("normal")
+    t2 = a.try_admit("normal")
+    # occupancy 2 >= low mark: low-priority traffic sheds FIRST
+    with pytest.raises(AdmissionError) as ei:
+        a.try_admit("low")
+    assert ei.value.status == 503
+    assert ei.value.retry_after_s >= 0.05
+    assert "low water mark 2 (depth 4)" in str(ei.value)
+    t3 = a.try_admit("normal")
+    with pytest.raises(AdmissionError) as ei:
+        a.try_admit("normal")
+    assert ei.value.status == 503
+    # high still lands at full depth
+    t4 = a.try_admit("high")
+    with pytest.raises(AdmissionError):
+        a.try_admit("high")
+    assert a.shedding_hard()
+    for t in (t1, t2, t3, t4):
+        a.release(t)
+    assert not a.shedding_hard()
+    s = a.stats()
+    assert s["occupancy"] == 0
+    assert s["admitted"] == 4
+    assert s["shed_queue"] == 3
+
+
+def test_admission_deadline_feasibility_429():
+    a = AdmissionController(depth=4, service_hint_ms=50.0)
+    with pytest.raises(AdmissionError) as ei:
+        a.try_admit("normal", deadline_ms=10.0)
+    assert ei.value.status == 429
+    assert ei.value.retry_after_s is None  # retrying cannot help
+    assert "below the observed service time" in str(ei.value)
+    assert a.stats()["shed_deadline"] == 1
+    # a feasible deadline admits and carries an ABSOLUTE deadline
+    t = a.try_admit("normal", deadline_ms=500.0)
+    assert t.deadline is not None
+    assert t.deadline > time.perf_counter()
+    a.release(t)
+
+
+def test_admission_release_idempotent_and_ewma():
+    a = AdmissionController(depth=2, service_hint_ms=100.0)
+    t = a.try_admit("normal")
+    a.release(t, service_ms=200.0)
+    a.release(t, service_ms=200.0)  # double release: no-op
+    s = a.stats()
+    assert s["occupancy"] == 0
+    assert s["service_ewma_ms"] == pytest.approx(120.0)  # 100 + 0.2*100
+
+
+def test_admission_default_deadline_and_bad_priority():
+    a = AdmissionController(depth=2, deadline_ms=300.0)
+    t = a.try_admit("normal")  # None falls back to the model default
+    assert t.deadline is not None
+    a.release(t)
+    with pytest.raises(ValueError, match="not one of"):
+        a.try_admit("urgent")
+    with pytest.raises(ValueError, match="must be >= 1"):
+        AdmissionController(depth=0)
+
+
+# ------------------------------------------------------------- canary ----
+
+
+def test_canary_clean_weights_promote(engine):
+    canary = CanaryController(engine, fraction=0.5, drift_limit=50.0,
+                              min_batches=2)
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=2, canary=canary)
+    try:
+        base = engine.current_generation
+        gen = canary.start(_clone_variables(engine))
+        assert gen != base and gen in engine.generations()
+        assert engine.current_generation == base  # staged, NOT current
+        seen = set()
+        # sequential submits: one batch each, so the 0.5 fraction
+        # alternates base/canary deterministically and promotion needs
+        # exactly 2 canary batches + 2 clean shadow evals
+        for i in range(20):
+            f = b.submit_array(_rand_images(1, 32, seed=7 + i)[0])
+            f.result(timeout=30)
+            seen.add(f.generation)
+            canary.drain_evals()
+            if canary.status()["state"] == "promoted":
+                break
+        st = canary.status()
+        assert st["state"] == "promoted"
+        assert st["max_drift"] == 0.0
+        assert st["clean_evals"] >= 2
+        # every batch ran a SINGLE pinned generation from {base, canary}
+        assert seen <= {base, gen}
+        assert engine.current_generation == gen
+    finally:
+        b.close()
+        canary.close()
+
+
+def test_canary_genuine_drift_rolls_back(engine):
+    before = obs.get_registry().counter("Serve/canary_rollbacks").value
+    canary = CanaryController(engine, fraction=0.5, drift_limit=0.01,
+                              min_batches=2)
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=2, canary=canary)
+    try:
+        base = engine.current_generation
+        # a DIFFERENT random init genuinely disagrees with the baseline
+        gen = canary.start(_fresh_variables(engine, seed=99))
+        futs = [b.submit_array(img)
+                for img in _rand_images(10, 32, seed=8)]
+        for f in futs:
+            f.result(timeout=30)  # canary batches still ANSWER
+        canary.drain_evals()
+        st = canary.status()
+        assert st["state"] == "rolled_back"
+        assert "logit drift" in st["rollback_reason"]
+        assert st["rollbacks"] == 1
+        after = obs.get_registry().counter("Serve/canary_rollbacks").value
+        assert after == before + 1
+        # default traffic was never switched; the staged gen drains away
+        assert engine.current_generation == base
+        deadline = time.perf_counter() + 10
+        while gen in engine.generations():
+            assert time.perf_counter() < deadline, "staged gen not dropped"
+            time.sleep(0.02)
+        assert not canary.rolling_back  # window over once drained
+        # post-rollback traffic serves the baseline
+        f = b.submit_array(_rand_images(1, 32, seed=9)[0])
+        f.result(timeout=30)
+        assert f.generation == base
+    finally:
+        b.close()
+        canary.close()
+
+
+def test_canary_latency_gate_rolls_back(engine):
+    canary = CanaryController(engine, fraction=0.5, drift_limit=50.0,
+                              lat_factor=5.0, min_batches=8)
+    try:
+        gen = canary.start(_clone_variables(engine))
+        base = canary.status()["base_gen"]
+        # fabricate the latency evidence: 3 fast baseline batches, then
+        # canary batches 10x slower (shadow=None skips the drift eval)
+        for _ in range(3):
+            canary.observe(base, 4, 4, 2.0, None, None)
+        for _ in range(2):
+            canary.observe(gen, 4, 4, 20.0, None, None)
+        assert canary.status()["state"] == "canary"  # needs >= 3 each
+        canary.observe(gen, 4, 4, 20.0, None, None)
+        st = canary.status()
+        assert st["state"] == "rolled_back"
+        assert "x baseline" in st["rollback_reason"]
+    finally:
+        canary.close()
+
+
+def test_canary_double_start_refused(engine):
+    canary = CanaryController(engine, fraction=0.5, min_batches=8)
+    try:
+        canary.start(_clone_variables(engine))
+        n_gens = len(engine.generations())
+        with pytest.raises(RuntimeError, match="already in progress"):
+            canary.start(_clone_variables(engine))
+        # the refused stage was discarded, not leaked
+        deadline = time.perf_counter() + 10
+        while len(engine.generations()) > n_gens:
+            assert time.perf_counter() < deadline
+            time.sleep(0.02)
+    finally:
+        with canary._lock:
+            staged = canary._canary_gen
+            canary._state = "idle"
+        engine.discard_staged(staged)
+        canary.close()
+    deadline = time.perf_counter() + 10
+    while len(engine.generations()) > 1:
+        assert time.perf_counter() < deadline
+        time.sleep(0.02)
+
+
+def test_canary_fraction_validated(engine):
+    with pytest.raises(ValueError, match="must be in"):
+        CanaryController(engine, fraction=1.0)
+
+
+# ------------------------------------------------------------- router ----
+
+
+@pytest.fixture(scope="module")
+def router():
+    big = build_served_model("big", "resnet18", _knobs(),
+                            num_classes=8, image_size=32)
+    tiny = build_served_model("tiny", "resnet18", _knobs(queue_depth=2),
+                              num_classes=8, image_size=32)
+    r = ModelRouter([big, tiny])
+    yield r
+    r.close()
+
+
+def test_router_routes_and_releases_occupancy(router):
+    img = _rand_images(1, 32, seed=1)[0]
+    f_default = router.submit(img=img)
+    f_named = router.submit(img=img, model="tiny")
+    out_d = f_default.result(timeout=30)
+    out_n = f_named.result(timeout=30)
+    assert out_d.shape == (8,) and out_n.shape == (8,)
+    # same arch + same pixels: the routes hit DIFFERENT engines but the
+    # request surface is uniform
+    with pytest.raises(KeyError, match="no model 'nope'"):
+        router.submit(img=img, model="nope")
+    # the done-callback released both tickets (occupancy covers the
+    # whole lifecycle, so it may trail the result by a beat)
+    deadline = time.perf_counter() + 10
+    while time.perf_counter() < deadline:
+        occ = [m["admission"]["occupancy"]
+               for m in router.stats().values()]
+        if occ == [0, 0]:
+            break
+        time.sleep(0.01)
+    assert occ == [0, 0]
+    # the EWMA learned from the served requests
+    assert router.models["big"].admission.stats()["admitted"] >= 1
+
+
+def test_router_per_model_shedding(router):
+    # saturate ONLY tiny (depth 2: normal mark = 2) with unreleased
+    # tickets; big keeps serving
+    adm = router.models["tiny"].admission
+    t1 = adm.try_admit("normal")
+    t2 = adm.try_admit("normal")
+    try:
+        with pytest.raises(AdmissionError) as ei:
+            router.submit(img=_rand_images(1, 32, seed=2)[0],
+                          model="tiny")
+        assert ei.value.status == 503
+        ready, reasons = router.readiness()
+        assert not ready and reasons == ["tiny: shedding"]
+        out = router.submit(
+            img=_rand_images(1, 32, seed=2)[0], model="big"
+        ).result(timeout=30)
+        assert out.shape == (8,)
+    finally:
+        adm.release(t1)
+        adm.release(t2)
+    ready, reasons = router.readiness()
+    assert ready and reasons == []
+
+
+def test_router_releases_ticket_on_submit_failure():
+    m = build_served_model("solo", "resnet18", _knobs(queue_depth=2),
+                           num_classes=8, image_size=32)
+    r = ModelRouter([m])
+    try:
+        m.batcher.close(drain=False)
+        with pytest.raises(ServeError, match="shut down"):
+            r.submit(img=_rand_images(1, 32, seed=3)[0])
+        # the ticket came back: the dead batcher didn't eat the depth
+        assert m.admission.stats()["occupancy"] == 0
+        ready, reasons = r.readiness()
+        assert not ready and reasons == ["solo: draining"]
+    finally:
+        r.close(drain=False)
+
+
+def test_router_needs_models_and_unique_names():
+    with pytest.raises(ValueError, match="at least one model"):
+        ModelRouter([])

@@ -1,0 +1,226 @@
+"""HTTP front-end locks (ISSUE 17): liveness vs readiness, the predict
+status surface (400/404/429/503/504), and the client-disconnect
+hygiene fix — a peer that hangs up mid-request must get its request
+CANCELLED so the staging row is compacted away and the admission ticket
+releases (the conftest lease-leak guard polices the session for the
+leak this test would otherwise plant).
+"""
+
+import io
+import json
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dptpu.serve import staging as serve_staging
+from dptpu.serve.http import make_handler
+from dptpu.serve.knobs import ServeKnobs
+from dptpu.serve.router import ModelRouter, build_served_model
+
+
+def _png_bytes(size=48, seed=0):
+    from PIL import Image
+
+    arr = np.random.RandomState(seed).randint(
+        0, 256, (size, size, 3), np.uint8
+    )
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _knobs(**over):
+    base = dict(
+        buckets=(1, 4), max_delay_ms=0.0, placement="auto", slots=2,
+        queue_depth=8, priorities=(1.0, 0.85, 0.6), deadline_ms=0.0,
+        canary_fraction=0.5, canary_drift=50.0, canary_lat_factor=5.0,
+    )
+    base.update(over)
+    return ServeKnobs(**base)
+
+
+@pytest.fixture(scope="module")
+def server():
+    # "main" answers immediately; "slow" coalesces for seconds — long
+    # enough for a disconnect to land while the request is still pending
+    router = ModelRouter([
+        build_served_model("main", "resnet18", _knobs(),
+                           num_classes=8, image_size=32),
+        build_served_model("slow", "resnet18",
+                           _knobs(max_delay_ms=4000.0, queue_depth=4),
+                           num_classes=8, image_size=32),
+    ])
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(router))
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="dptpu-test-httpd", daemon=True)
+    t.start()
+    try:
+        yield httpd.server_address[1], router
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(10)
+        router.close(drain=False)
+
+
+def _request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        payload = json.loads(resp.read().decode())
+        return resp.status, dict(resp.getheaders()), payload
+    finally:
+        conn.close()
+
+
+def test_healthz_is_liveness_only(server):
+    port, _ = server
+    status, _, payload = _request(port, "GET", "/healthz")
+    assert status == 200 and payload["ok"]
+    assert set(payload["models"]) == {"main", "slow"}
+    m = payload["models"]["main"]
+    assert m["arch"] == "resnet18" and m["buckets"] == [1, 4]
+    assert m["generation"] >= 1
+
+
+def test_readyz_reflects_shedding(server):
+    port, router = server
+    status, _, payload = _request(port, "GET", "/readyz")
+    assert status == 200 and payload["ready"]
+    adm = router.models["slow"].admission
+    held = [adm.try_admit("high") for _ in range(adm.thresholds["normal"])]
+    try:
+        status, _, payload = _request(port, "GET", "/readyz")
+        assert status == 503 and not payload["ready"]
+        assert payload["reasons"] == ["slow: shedding"]
+        # liveness is UNAFFECTED: the process is still up
+        status, _, _ = _request(port, "GET", "/healthz")
+        assert status == 200
+    finally:
+        for t in held:
+            adm.release(t)
+    status, _, _ = _request(port, "GET", "/readyz")
+    assert status == 200
+
+
+def test_predict_default_and_named_routes(server):
+    port, router = server
+    body = _png_bytes(seed=1)
+    status, _, payload = _request(port, "POST", "/predict", body=body)
+    assert status == 200
+    assert payload["model"] == "main"
+    assert len(payload["top5"]) == 5
+    assert payload["generation"] >= 1
+    assert payload["timings"]["bucket"] in (1, 4)
+    status, _, payload = _request(port, "POST", "/predict/main", body=body)
+    assert status == 200 and payload["model"] == "main"
+    status, _, payload = _request(port, "POST", "/predict/nope", body=body)
+    assert status == 404 and "no model" in payload["error"]
+    status, _, payload = _request(port, "POST", "/nope", body=body)
+    assert status == 404
+    status, _, payload = _request(port, "GET", "/nope")
+    assert status == 404
+
+
+def test_predict_rejects_bad_inputs(server):
+    port, _ = server
+    status, _, payload = _request(port, "POST", "/predict",
+                                  body=b"not an image")
+    assert status == 400
+    status, _, payload = _request(port, "POST", "/predict", body=b"")
+    assert status == 400 and "body" in payload["error"]
+    status, _, payload = _request(
+        port, "POST", "/predict", body=_png_bytes(),
+        headers={"X-DPTPU-Priority": "urgent"},
+    )
+    assert status == 400 and "not one of" in payload["error"]
+    status, _, payload = _request(
+        port, "POST", "/predict", body=_png_bytes(),
+        headers={"X-DPTPU-Deadline-Ms": "banana"},
+    )
+    assert status == 400 and "millisecond budget" in payload["error"]
+    status, _, payload = _request(
+        port, "POST", "/predict", body=_png_bytes(),
+        headers={"X-DPTPU-Deadline-Ms": "-5"},
+    )
+    assert status == 400
+
+
+def test_predict_sheds_with_429_and_503(server):
+    port, router = server
+    # 1 ms against the 50 ms service hint: infeasible, no Retry-After
+    status, headers, payload = _request(
+        port, "POST", "/predict", body=_png_bytes(),
+        headers={"X-DPTPU-Deadline-Ms": "1"},
+    )
+    assert status == 429
+    assert "Retry-After" not in headers
+    assert "infeasible" in payload["error"]
+    # saturate main's normal water mark: 503 + Retry-After
+    adm = router.models["main"].admission
+    held = [adm.try_admit("high") for _ in range(adm.thresholds["normal"])]
+    try:
+        status, headers, payload = _request(
+            port, "POST", "/predict", body=_png_bytes(),
+        )
+        assert status == 503
+        assert float(headers["Retry-After"]) >= 0.05
+        assert "water mark" in payload["error"]
+    finally:
+        for t in held:
+            adm.release(t)
+
+
+def test_client_disconnect_cancels_and_releases(server):
+    """The satellite-2 lock: hang up mid-request and prove the request
+    is withdrawn — cancelled counter bumps, the admission ticket comes
+    back, and no staging lease leaks (session guard backstops)."""
+    port, router = server
+    m = router.models["slow"]
+    leaks_before = serve_staging.leaked_lease_count()
+    cancelled_before = m.batcher.stats(reset_window=False)["cancelled"]
+    body = _png_bytes(seed=2)
+    raw = (
+        f"POST /predict/slow HTTP/1.1\r\n"
+        f"Host: 127.0.0.1:{port}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: keep-alive\r\n\r\n"
+    ).encode() + body
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(raw)
+        # let the handler read the body and submit into the batcher,
+        # where the 4 s coalescing window holds the request pending
+        deadline = time.perf_counter() + 10
+        while m.admission.stats()["occupancy"] == 0:
+            assert time.perf_counter() < deadline, "request never admitted"
+            time.sleep(0.01)
+    finally:
+        s.close()  # the client vanishes mid-wait
+    deadline = time.perf_counter() + 15
+    while (m.batcher.stats(reset_window=False)["cancelled"]
+           == cancelled_before):
+        assert time.perf_counter() < deadline, \
+            "disconnect did not cancel the pending request"
+        time.sleep(0.05)
+    # the done-callback returned the admission ticket...
+    deadline = time.perf_counter() + 10
+    while m.admission.stats()["occupancy"]:
+        assert time.perf_counter() < deadline, "occupancy never released"
+        time.sleep(0.01)
+    # ...and the slot was abandoned, not leased-and-lost
+    deadline = time.perf_counter() + 10
+    while m.batcher.stats(reset_window=False)["dead_rows"] == 0:
+        assert time.perf_counter() < deadline, "row never compacted away"
+        time.sleep(0.05)
+    assert m.batcher._ring.leased_count() == 0
+    assert serve_staging.leaked_lease_count() == leaks_before
+    # the server is still healthy for the NEXT client
+    status, _, _ = _request(port, "POST", "/predict", body=_png_bytes())
+    assert status == 200

@@ -9,14 +9,24 @@ import pytest
 from dptpu.cli import build_serve_parser, serve_args_to_knobs
 from dptpu.serve import (
     DEFAULT_BUCKETS,
+    DEFAULT_CANARY_DRIFT,
+    DEFAULT_CANARY_FRACTION,
+    DEFAULT_CANARY_LAT_FACTOR,
+    DEFAULT_DEADLINE_MS,
     DEFAULT_MAX_DELAY_MS,
+    DEFAULT_PRIORITIES,
+    DEFAULT_QUEUE_DEPTH,
     DEFAULT_SLOTS,
     parse_buckets,
+    parse_priorities,
     serve_knobs,
 )
 
 _KNOBS = ("DPTPU_SERVE_BUCKETS", "DPTPU_SERVE_MAX_DELAY_MS",
-          "DPTPU_SERVE_PLACEMENT", "DPTPU_SERVE_SLOTS")
+          "DPTPU_SERVE_PLACEMENT", "DPTPU_SERVE_SLOTS",
+          "DPTPU_SERVE_QUEUE_DEPTH", "DPTPU_SERVE_PRIORITIES",
+          "DPTPU_SERVE_DEADLINE_MS", "DPTPU_SERVE_CANARY_FRACTION",
+          "DPTPU_SERVE_CANARY_DRIFT", "DPTPU_SERVE_CANARY_LAT_FACTOR")
 
 
 @pytest.fixture(autouse=True)
@@ -28,7 +38,9 @@ def _clean_env(monkeypatch):
 def test_defaults():
     k = serve_knobs()
     assert k == (DEFAULT_BUCKETS, DEFAULT_MAX_DELAY_MS, "auto",
-                 DEFAULT_SLOTS)
+                 DEFAULT_SLOTS, DEFAULT_QUEUE_DEPTH, DEFAULT_PRIORITIES,
+                 DEFAULT_DEADLINE_MS, DEFAULT_CANARY_FRACTION,
+                 DEFAULT_CANARY_DRIFT, DEFAULT_CANARY_LAT_FACTOR)
 
 
 def test_env_overrides_cli_values(monkeypatch):
@@ -36,15 +48,28 @@ def test_env_overrides_cli_values(monkeypatch):
     monkeypatch.setenv("DPTPU_SERVE_MAX_DELAY_MS", "12.5")
     monkeypatch.setenv("DPTPU_SERVE_PLACEMENT", "replicated")
     monkeypatch.setenv("DPTPU_SERVE_SLOTS", "6")
+    monkeypatch.setenv("DPTPU_SERVE_QUEUE_DEPTH", "32")
+    monkeypatch.setenv("DPTPU_SERVE_PRIORITIES", "1.0,0.5,0.25")
+    monkeypatch.setenv("DPTPU_SERVE_DEADLINE_MS", "250")
+    monkeypatch.setenv("DPTPU_SERVE_CANARY_FRACTION", "0.25")
+    monkeypatch.setenv("DPTPU_SERVE_CANARY_DRIFT", "7.5")
+    monkeypatch.setenv("DPTPU_SERVE_CANARY_LAT_FACTOR", "3.0")
     k = serve_knobs(buckets="1,4", max_delay_ms=1.0, placement="tp",
-                    slots=2)
-    assert k == ((2, 8), 12.5, "replicated", 6)
+                    slots=2, queue_depth=8, priorities="1.0,0.9,0.8",
+                    deadline_ms=10.0, canary_fraction=0.5,
+                    canary_drift=1.0, canary_lat_factor=2.0)
+    assert k == ((2, 8), 12.5, "replicated", 6, 32, (1.0, 0.5, 0.25),
+                 250.0, 0.25, 7.5, 3.0)
 
 
 def test_cli_values_pass_through():
     k = serve_knobs(buckets="1,2,4", max_delay_ms=0.0,
-                    placement="replicated", slots=3)
-    assert k == ((1, 2, 4), 0.0, "replicated", 3)
+                    placement="replicated", slots=3, queue_depth=16,
+                    priorities=(1.0, 0.75, 0.5), deadline_ms=100.0,
+                    canary_fraction=0.2, canary_drift=2.0,
+                    canary_lat_factor=4.0)
+    assert k == ((1, 2, 4), 0.0, "replicated", 3, 16, (1.0, 0.75, 0.5),
+                 100.0, 0.2, 2.0, 4.0)
 
 
 def test_buckets_must_be_sorted_positive():
@@ -86,16 +111,105 @@ def test_slots_validated():
         serve_knobs(slots=0)
 
 
+def test_queue_depth_validated():
+    with pytest.raises(ValueError, match="DPTPU_SERVE_QUEUE_DEPTH"):
+        serve_knobs(environ={"DPTPU_SERVE_QUEUE_DEPTH": "0"})
+    with pytest.raises(ValueError, match="--queue-depth"):
+        serve_knobs(queue_depth=-3)
+    with pytest.raises(ValueError,
+                       match="admitted-but-unanswered"):
+        serve_knobs(queue_depth=0)
+    # unset/empty = default (the contract's absence rule)
+    assert serve_knobs(environ={"DPTPU_SERVE_QUEUE_DEPTH": ""}) \
+        .queue_depth == DEFAULT_QUEUE_DEPTH
+
+
+def test_priorities_validated():
+    with pytest.raises(ValueError, match="comma list of fractions"):
+        serve_knobs(environ={"DPTPU_SERVE_PRIORITIES": "hi,mid,lo"})
+    with pytest.raises(ValueError, match="exactly 3 thresholds"):
+        serve_knobs(environ={"DPTPU_SERVE_PRIORITIES": "1.0,0.5"})
+    with pytest.raises(ValueError, match=r"in \(0, 1\]"):
+        serve_knobs(environ={"DPTPU_SERVE_PRIORITIES": "1.5,0.5,0.2"})
+    with pytest.raises(ValueError, match=r"in \(0, 1\]"):
+        serve_knobs(environ={"DPTPU_SERVE_PRIORITIES": "1.0,0.5,0"})
+    with pytest.raises(ValueError, match="non-increasing"):
+        serve_knobs(environ={"DPTPU_SERVE_PRIORITIES": "0.5,0.8,0.2"})
+    # programmatic values get the identical validation
+    with pytest.raises(ValueError, match="--priorities"):
+        parse_priorities((0.5, 0.8, 0.2), source="--priorities")
+    assert serve_knobs(environ={"DPTPU_SERVE_PRIORITIES": ""}) \
+        .priorities == DEFAULT_PRIORITIES
+
+
+def test_deadline_validated():
+    with pytest.raises(ValueError, match="DPTPU_SERVE_DEADLINE_MS"):
+        serve_knobs(environ={"DPTPU_SERVE_DEADLINE_MS": "-5"})
+    with pytest.raises(ValueError, match="DPTPU_SERVE_DEADLINE_MS"):
+        serve_knobs(environ={"DPTPU_SERVE_DEADLINE_MS": "whenever"})
+    with pytest.raises(ValueError, match="--deadline-ms"):
+        serve_knobs(deadline_ms=-1.0)
+    # 0 is VALID: no server-imposed default deadline
+    assert serve_knobs(deadline_ms=0.0).deadline_ms == 0.0
+
+
+def test_canary_fraction_validated():
+    for bad in ("0", "1", "1.5", "-0.1"):
+        with pytest.raises(ValueError,
+                           match=r"DPTPU_SERVE_CANARY_FRACTION.*\(0, 1\)"):
+            serve_knobs(environ={"DPTPU_SERVE_CANARY_FRACTION": bad})
+    with pytest.raises(ValueError, match="--canary-fraction"):
+        serve_knobs(canary_fraction=1.0)
+
+
+def test_canary_drift_validated():
+    with pytest.raises(ValueError, match="DPTPU_SERVE_CANARY_DRIFT"):
+        serve_knobs(environ={"DPTPU_SERVE_CANARY_DRIFT": "0"})
+    with pytest.raises(ValueError, match="--canary-drift"):
+        serve_knobs(canary_drift=-2.0)
+    with pytest.raises(ValueError, match="auto-rollback"):
+        serve_knobs(canary_drift=0.0)
+
+
+def test_canary_lat_factor_validated():
+    with pytest.raises(ValueError,
+                       match="DPTPU_SERVE_CANARY_LAT_FACTOR"):
+        serve_knobs(environ={"DPTPU_SERVE_CANARY_LAT_FACTOR": "1.0"})
+    with pytest.raises(ValueError, match="--canary-lat-factor"):
+        serve_knobs(canary_lat_factor=0.5)
+    with pytest.raises(ValueError, match="measurement noise"):
+        serve_knobs(canary_lat_factor=1.0)
+
+
 def test_cli_parse_and_unknown_arch():
     p = build_serve_parser()
     args = p.parse_args(["-a", "resnet18", "--buckets", "1,8",
                          "--max-delay-ms", "3", "--placement",
-                         "replicated"])
+                         "replicated", "--queue-depth", "16",
+                         "--priorities", "1.0,0.9,0.5",
+                         "--deadline-ms", "200",
+                         "--canary-fraction", "0.2"])
     k = serve_args_to_knobs(args)
     assert k.buckets == (1, 8) and k.max_delay_ms == 3.0
+    assert k.queue_depth == 16 and k.priorities == (1.0, 0.9, 0.5)
+    assert k.deadline_ms == 200.0 and k.canary_fraction == 0.2
     args = p.parse_args(["-a", "resnet999"])
     with pytest.raises(ValueError, match="resnet999"):
         serve_args_to_knobs(args)
+
+
+def test_cli_multi_model_specs():
+    from dptpu.cli import parse_model_specs
+
+    assert parse_model_specs("resnet18") == [("resnet18", "resnet18")]
+    assert parse_model_specs("resnet18,tiny=resnet18") == \
+        [("resnet18", "resnet18"), ("tiny", "resnet18")]
+    with pytest.raises(ValueError, match="twice"):
+        parse_model_specs("resnet18,resnet18")
+    with pytest.raises(ValueError, match="resnet999"):
+        parse_model_specs("resnet18,resnet999")
+    with pytest.raises(ValueError, match="at least one"):
+        parse_model_specs(",")
 
 
 def test_cli_bad_knob_fails_before_any_engine(monkeypatch):

@@ -1,8 +1,10 @@
 """Tier-1 smoke of scripts/run_servebench.py (the pattern of
 test_obsbench_smoke.py): the serving stack's latency/throughput curves,
-bucket accounting, padded-parity gate and tail gate are continuously
-checked — one subprocess, smallest preset, same gate logic as the
-committed SERVEBENCH.json."""
+bucket accounting, padded-parity gate, tail gate and the ISSUE 17
+robustness arms (overload shedding, multi-model, canary auto-rollback,
+dead-request hygiene, serve faults) are continuously checked — one
+subprocess, smallest preset, same gate logic as the committed
+SERVEBENCH.json."""
 
 import json
 import os
@@ -54,3 +56,36 @@ def test_servebench_smoke_gates(tmp_path):
     # the tail gate is evaluated at the SLO-typical 0.5x-saturation point
     assert bench["tail_gate"]["at_offered_frac"] == 0.5
     assert bench["tail_gate"]["p99_ms"] <= bench["tail_gate"]["budget_ms"]
+    # robustness arms (ISSUE 17), all gated
+    g = bench["gates"]
+    assert g["shed_ok"] and g["multi_model_ok"] and g["canary_ok"]
+    assert g["hygiene_ok"] and g["faults_ok"]
+    rb = bench["robustness"]
+    # overload: 2x saturation through admission actually shed, admitted
+    # p99 stayed bounded, and every shed decision beat a service time
+    shed = rb["overload_shedding"]
+    assert shed["shed"] > 0 and shed["admitted"] > 0
+    assert shed["admitted_p99_ms"] <= shed["admitted_p99_budget_ms"]
+    assert shed["shed_decision_p99_ms"] < shed["admitted_p50_ms"]
+    # multi-model: two co-resident engines both completed under
+    # concurrent load, per-model p99s on record
+    mm = rb["multi_model"]["models"]
+    assert set(mm) == {"a", "b"}
+    assert all(m["p99_ms"] > 0 and m["requests"] > 0 for m in mm.values())
+    # canary: the injected drift triggered EXACTLY one loud rollback and
+    # no response ever mixed generations
+    can = rb["canary_rollback"]
+    assert can["state"] == "rolled_back" and can["rollbacks"] == 1
+    assert can["mixed_generation_responses"] == 0
+    assert can["post_rollback_serves_base"]
+    assert "ROLLED BACK" in proc.stderr
+    # hygiene: 4 cancelled of 6 claimed -> dispatched at the LIVE
+    # count's bucket; padding-waste accounting proves zero dead rows
+    hyg = rb["dead_request_hygiene"]
+    assert hyg["dead_rows"] == 4
+    assert hyg["dispatched_bucket"] < hyg["claimed_bucket"]
+    # every serve fault scenario green
+    flt = rb["serve_faults"]
+    assert flt["serve_exception"]["ok"]
+    assert flt["preprocess_crash"]["ok"]
+    assert flt["slow_model"]["ok"]
