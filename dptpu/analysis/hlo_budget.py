@@ -25,7 +25,12 @@ committed budget table ``HLO_BUDGETS.json``:
   ``gspmd_hier`` keeps DCN bytes under half of flat GSPMD's all-DCN
   volume on the ``{slice, data}``-factored mesh, and ``gspmd_overlap``
   holds the partitioner's reduction volume at the DDP analytic with
-  the same interleaving evidence as the shard_map overlap configs.
+  the same interleaving evidence as the shard_map overlap configs;
+* the serve-quant config (ISSUE 18): the int8 serve forward's
+  REQUESTED matmul dtypes, from pre-optimization HLO (this backend's
+  float normalization hides them post-optimization) — s8 parameters
+  present, >= 1 bf16 dot/convolution, ZERO f32/f64 dots — so a silent
+  fp32 fallback in the quantized fast path fails statically.
 
 A comms/sharding regression therefore fails ``dptpu check`` BEFORE any
 bench runs. After an INTENDED change, re-commit the table with
@@ -51,7 +56,8 @@ _SLICES = 2
 
 REPRESENTATIVE_CONFIGS = ("ddp", "zero1", "accum", "slices",
                           "ddp_overlap", "zero1_overlap", "slices_overlap",
-                          "zero3", "gspmd_hier", "gspmd_overlap")
+                          "zero3", "gspmd_hier", "gspmd_overlap",
+                          "serve_quant")
 
 # bucket bound for the overlap configs: small enough that the probe
 # model's ~7 KB of gradients split into >= 2 buckets (the evidence
@@ -247,16 +253,50 @@ def _compile_config(name: str) -> Tuple[str, dict]:
     return step.lower(st, batch).compile().as_text(), facts
 
 
-def extract_budget(name: str) -> Tuple[dict, dict]:
+def _serve_quant_hlo() -> str:
+    """Pre-optimization HLO of the serve engine's REAL int8 forward
+    (``ServeEngine._forward_int8`` on a quantized resnet18@32 tree) —
+    lowered, not compiled: the requested dot dtypes are the gate, and
+    they exist before XLA's backend-specific rewrites (this container's
+    CPU backend promotes bf16 gemms to f32 in the optimized text)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dptpu.ops.quant import quantize_tree
+    from dptpu.serve.engine import ServeEngine
+
+    engine = ServeEngine("resnet18", buckets=(1,), num_classes=8,
+                         image_size=32, placement="replicated")
+    qvars = {
+        "params": quantize_tree(engine._host_variables["params"]),
+        "batch_stats": engine._host_variables["batch_stats"],
+    }
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), qvars
+    )
+    img = jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.uint8)
+    return jax.jit(engine._forward_int8).lower(
+        structs, img
+    ).compiler_ir(dialect="hlo").as_hlo_text()
+
+
+def extract_budget(name: str) -> Tuple[dict, Optional[dict]]:
     """Parse one config's compiled program into its budget row."""
     from dptpu.parallel.hlo_accounting import (
         collective_bytes_by_link,
         collective_bytes_per_chip,
         donated_alias_count,
+        dot_dtype_census,
         op_census,
         overlap_evidence,
         parse_collectives,
     )
+
+    if name == "serve_quant":
+        txt = _serve_quant_hlo()
+        row = dot_dtype_census(txt)
+        row["f64_shapes"] = op_census(txt)["f64_shapes"]
+        return row, None
 
     txt, facts = _compile_config(name)
     inner = _N // _SLICES
@@ -292,7 +332,9 @@ def compute_budgets() -> dict:
     configs = {}
     facts = None
     for name in REPRESENTATIVE_CONFIGS:
-        configs[name], facts = extract_budget(name)
+        configs[name], f = extract_budget(name)
+        if f is not None:
+            facts = f
     return {
         "version": 1,
         "geometry": {"devices": _N, "slices": _SLICES,
@@ -486,6 +528,35 @@ def _analytic_violations(computed: dict) -> List[BudgetViolation]:
                 f"schedule no longer overlaps the reductions with "
                 f"backward computation",
             ))
+    # serve-quant (ISSUE 18): the int8 serve forward's REQUESTED matmul
+    # dtypes, asserted statically — a refactor that lets the fp32 model
+    # dtype promote the dequantized weights back to f32 (the silent
+    # fallback that keeps the residency win but loses the compute win)
+    # fails here before any bench runs
+    sq = cfg["serve_quant"]
+    if sq["s8_params"] < 1:
+        out.append(BudgetViolation(
+            "serve_quant", "s8_params",
+            "no s8 parameters in the int8 forward — the quantized "
+            "weights no longer travel int8 (did stage_quantized start "
+            "dequantizing on the host?)",
+        ))
+    if sq["dots"].get("bf16", 0) < 1:
+        out.append(BudgetViolation(
+            "serve_quant", "dots.bf16",
+            f"{sq['dots']} — the int8 forward requests no bf16 "
+            f"dot/convolution at all",
+        ))
+    fp_dots = sq["dots"].get("f32", 0) + sq["dots"].get("f64", 0)
+    if fp_dots:
+        out.append(BudgetViolation(
+            "serve_quant", "dots.f32",
+            f"{fp_dots} f32/f64 dot/convolution instructions in the "
+            f"int8 forward ({sq['dots']}) — a silent fp32 fallback: "
+            f"some layer's inputs or weights promoted past bf16 "
+            f"(check the model's dtype attribute survives "
+            f"ServeEngine._bf16_model)",
+        ))
     for name, row in cfg.items():
         if row["f64_shapes"]:
             out.append(BudgetViolation(
@@ -493,6 +564,8 @@ def _analytic_violations(computed: dict) -> List[BudgetViolation]:
                 f"{row['f64_shapes']} f64 shapes in the compiled "
                 f"program — an accidental double-precision promotion",
             ))
+        if name == "serve_quant":
+            continue  # an inference forward: donates nothing
         if row["alias_entries"] < computed["model"]["param_leaves"]:
             out.append(BudgetViolation(
                 name, "alias_entries",
@@ -533,7 +606,8 @@ def check_hlo_budgets(
             ))
             continue
         for field in ("collective_instructions", "per_chip", "by_link",
-                      "alias_entries", "f64_shapes", "overlap"):
+                      "alias_entries", "f64_shapes", "overlap",
+                      "dots", "s8_params"):
             if field not in got and field not in want:
                 continue
             if got.get(field) != want.get(field):

@@ -98,6 +98,16 @@ KNOB_REGISTRY = {
     "DPTPU_SERVE_CANARY_FRACTION": _k("float", "serve"),
     "DPTPU_SERVE_CANARY_DRIFT": _k("float", "serve"),
     "DPTPU_SERVE_CANARY_LAT_FACTOR": _k("float", "serve"),
+    # quantized serving
+    "DPTPU_QUANT_PRECISION": _k("choice", "serve"),
+    "DPTPU_QUANT_CALIB": _k("str", "serve"),
+    "DPTPU_QUANT_DRIFT": _k("float", "serve"),
+    "DPTPU_QUANT_TOP1_MIN": _k("float", "serve"),
+    # serve fleet
+    "DPTPU_FLEET_DIR": _k("str", "serve"),
+    "DPTPU_FLEET_HEARTBEAT_S": _k("float", "serve"),
+    "DPTPU_FLEET_DEADLINE_S": _k("float", "serve"),
+    "DPTPU_FLEET_RETRIES": _k("int", "serve"),
     # analysis / sanitizers
     "DPTPU_SYNC_CHECK": _k("bool", "analysis"),
     # bench-driver child sentinels (subprocess re-entry guards)

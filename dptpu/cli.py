@@ -103,6 +103,43 @@ def build_serve_parser():
                    help="canary/baseline batch-latency multiple before "
                         "auto-rollback (default 5.0; env "
                         "DPTPU_SERVE_CANARY_LAT_FACTOR)")
+    p.add_argument("--precision", default=None,
+                   help="serve precision: fp32 | bf16 | int8 (default "
+                        "fp32; below fp32 needs --calib and deploys "
+                        "through the canary drift gate; env "
+                        "DPTPU_QUANT_PRECISION)")
+    p.add_argument("--calib", default=None, metavar="PATH",
+                   help="calibration artifact from `dptpu quantize` "
+                        "(required for --precision bf16/int8; env "
+                        "DPTPU_QUANT_CALIB)")
+    p.add_argument("--quant-drift", type=float, default=None,
+                   help="override the quantized rollout's max|dlogit| "
+                        "gate (default 0 = the artifact's bound; env "
+                        "DPTPU_QUANT_DRIFT)")
+    p.add_argument("--quant-top1-min", type=float, default=None,
+                   help="override the quantized rollout's top-1 "
+                        "agreement floor (default 0 = the artifact's "
+                        "bound; env DPTPU_QUANT_TOP1_MIN)")
+    p.add_argument("--fleet", action="store_true",
+                   help="run the FLEET FRONT instead of a local engine: "
+                        "route requests over the serving hosts "
+                        "registered in --fleet-dir (members are plain "
+                        "`dptpu serve --fleet-dir ...` processes)")
+    p.add_argument("--fleet-dir", default=None, metavar="DIR",
+                   help="shared fleet membership directory (quorum KV); "
+                        "setting it on a serving host registers that "
+                        "host in the fleet (env DPTPU_FLEET_DIR)")
+    p.add_argument("--fleet-heartbeat-s", type=float, default=None,
+                   help="fleet member heartbeat period (default 1.0; "
+                        "env DPTPU_FLEET_HEARTBEAT_S)")
+    p.add_argument("--fleet-deadline-s", type=float, default=None,
+                   help="heartbeat staleness before a member is "
+                        "auto-drained from routing (default 3.0; env "
+                        "DPTPU_FLEET_DEADLINE_S)")
+    p.add_argument("--fleet-retries", type=int, default=None,
+                   help="failover retries when a member connection "
+                        "dies mid-request (default 2; env "
+                        "DPTPU_FLEET_RETRIES)")
     p.add_argument("--pretrained", action="store_true",
                    help="load converted torchvision weights "
                         "($DPTPU_PRETRAINED_DIR/<arch>.npz)")
@@ -161,6 +198,13 @@ def serve_args_to_knobs(args):
         canary_fraction=args.canary_fraction,
         canary_drift=args.canary_drift,
         canary_lat_factor=args.canary_lat_factor,
+        precision=args.precision, calib=args.calib,
+        quant_drift=args.quant_drift,
+        quant_top1_min=args.quant_top1_min,
+        fleet_dir=args.fleet_dir,
+        fleet_heartbeat_s=args.fleet_heartbeat_s,
+        fleet_deadline_s=args.fleet_deadline_s,
+        fleet_retries=args.fleet_retries,
     )
     parse_model_specs(args.arch)
     return knobs
@@ -169,9 +213,13 @@ def serve_args_to_knobs(args):
 def main_serve(argv=None):
     """``dptpu serve``: load the model(s), AOT-compile each bucket
     ladder, and serve — over HTTP, or ``--selftest N`` synthetic
-    requests."""
+    requests. ``--fleet`` skips the local engine entirely and runs the
+    fleet ROUTING TIER over the hosts registered in the fleet dir."""
     args = build_serve_parser().parse_args(argv)
     knobs = serve_args_to_knobs(args)  # fail fast, pre-jax-compile
+
+    if args.fleet:
+        return _serve_fleet_front(args, knobs)
     specs = parse_model_specs(args.arch)
 
     from dptpu.serve import ModelRouter, build_served_model
@@ -184,9 +232,25 @@ def main_serve(argv=None):
         )
         for name, arch in specs
     ])
+    member = None
     try:
+        if knobs.precision != "fp32":
+            for name in router.models:
+                gen = router.start_quantized(knobs, name)
+                print(f"=> serve: staged {knobs.precision} generation "
+                      f"{gen} for {name!r} behind the canary drift gate "
+                      f"({knobs.calib})")
         if args.selftest:
             return _serve_selftest(router, args.selftest)
+        if knobs.fleet_dir:
+            from dptpu.serve.fleet import FleetMember
+
+            member = FleetMember(
+                knobs.fleet_dir, host=args.host, port=args.port,
+                heartbeat_s=knobs.fleet_heartbeat_s,
+            )
+            print(f"=> serve: registered fleet member "
+                  f"{member.member_id!r} in {knobs.fleet_dir}")
         print(
             f"=> dptpu serve: "
             f"{', '.join(f'{n} ({a})' for n, a in specs)} (buckets "
@@ -202,7 +266,40 @@ def main_serve(argv=None):
             for name, m in router.models.items()
         }
     finally:
+        if member is not None:
+            member.close()
         router.close()
+
+
+def _serve_fleet_front(args, knobs):
+    """The ``--fleet`` routing tier: no local engine — requests fan out
+    over the registered member hosts, a stale heartbeat auto-drains a
+    member, and the PR-17 admission layer fronts the whole fleet."""
+    if not knobs.fleet_dir:
+        raise SystemExit(
+            "--fleet needs the membership directory: set "
+            "DPTPU_FLEET_DIR/--fleet-dir to the shared quorum-KV path "
+            "the serving hosts register in"
+        )
+    from dptpu.serve.fleet import FleetRouter, serve_fleet_forever
+
+    fleet = FleetRouter(
+        knobs.fleet_dir, deadline_s=knobs.fleet_deadline_s,
+        poll_s=knobs.fleet_heartbeat_s, retries=knobs.fleet_retries,
+        queue_depth=knobs.queue_depth, priorities=knobs.priorities,
+        deadline_ms=knobs.deadline_ms,
+    )
+    try:
+        print(
+            f"=> dptpu serve --fleet: routing over {knobs.fleet_dir} "
+            f"on http://{args.host}:{args.port} (drain after "
+            f"{knobs.fleet_deadline_s}s heartbeat silence, "
+            f"{knobs.fleet_retries} failover retries)"
+        )
+        serve_fleet_forever(fleet, args.host, args.port)
+        return fleet.stats()
+    finally:
+        fleet.close()
 
 
 def _serve_selftest(router, n: int):
@@ -242,6 +339,161 @@ def _serve_selftest(router, n: int):
         )
         out[name] = stats
     return out if len(out) > 1 else next(iter(out.values()))
+
+
+def build_quantize_parser():
+    """``dptpu quantize`` flags: offline post-training calibration of a
+    serve model into a CRC-sealed artifact (dptpu/serve/quant.py)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dptpu quantize",
+        description="calibrate per-channel int8 scales for a serve "
+                    "model from a shard sample and commit them as a "
+                    "provenance-stamped, CRC-sealed calibration "
+                    "artifact (the only key that unlocks sub-fp32 "
+                    "serving)",
+    )
+    p.add_argument("-a", "--arch", default="resnet50", metavar="ARCH",
+                   help="registry architecture to calibrate")
+    p.add_argument("-o", "--out", required=True, metavar="PATH",
+                   help="calibration artifact output path")
+    p.add_argument("--pretrained", action="store_true",
+                   help="calibrate the converted torchvision weights "
+                        "($DPTPU_PRETRAINED_DIR/<arch>.npz)")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--data", default=None, metavar="DIR",
+                   help="packed-shard or ImageFolder directory to draw "
+                        "the calibration sample from (default: "
+                        "deterministic synthetic sample)")
+    p.add_argument("--sample", type=int, default=64, metavar="N",
+                   help="calibration sample size (default 64)")
+    p.add_argument("--drift-bound", type=float, default=None,
+                   help="max|dlogit| bound to stamp into the artifact "
+                        "(default: measured max drift x 2 margin)")
+    p.add_argument("--top1-min", type=float, default=None,
+                   help="top-1 agreement floor to stamp into the "
+                        "artifact (default: measured agreement less a "
+                        "0.05 margin, floored at 0.5)")
+    return p
+
+
+def main_quantize(argv=None):
+    """``dptpu quantize``: build the fp32 model, quantize, replay the
+    calibration sample through BOTH forwards, and seal scales + the
+    measured drift gate bounds into the artifact."""
+    import numpy as np
+
+    args = build_quantize_parser().parse_args(argv)
+    if args.sample < 1:
+        raise SystemExit(f"--sample {args.sample} must be >= 1")
+    if args.arch is not None:
+        parse_model_specs(args.arch.split(",")[0])
+
+    from dptpu.serve.engine import ServeEngine
+    from dptpu.serve.quant import (
+        DRIFT_MARGIN,
+        measure_drift,
+        quantize_variables,
+        save_calibration,
+    )
+
+    # one fp32 engine, replicated (quantized serving is replicated-only)
+    bucket = max(2, min(16, args.sample))
+    engine = ServeEngine(
+        args.arch, buckets=(bucket,), placement="replicated",
+        num_classes=args.num_classes, image_size=args.image_size,
+        pretrained=args.pretrained, verbose=True,
+    )
+    sample = _calibration_sample(
+        args.data, args.sample, args.image_size
+    )
+
+    params = engine._host_variables["params"]
+    gen_q = engine.stage_weights(
+        quantize_variables(engine._host_variables, "int8"),
+        precision="int8",
+    )
+    try:
+        base_parts, q_parts = [], []
+        for i in range(0, len(sample), bucket):
+            chunk = sample[i:i + bucket]
+            n = len(chunk)
+            if n < bucket:
+                pad = np.broadcast_to(
+                    chunk[0], (bucket - n,) + chunk.shape[1:]
+                )
+                chunk = np.concatenate([chunk, pad], axis=0)
+            base_parts.append(engine.run_bucket(bucket, chunk, n))
+            q_parts.append(engine.run_bucket(bucket, chunk, n, gen=gen_q))
+        base = np.concatenate(base_parts, axis=0)
+        quant = np.concatenate(q_parts, axis=0)
+    finally:
+        engine.discard_staged(gen_q)
+    agree, drift = measure_drift(base, quant)
+
+    drift_bound = (args.drift_bound if args.drift_bound is not None
+                   else max(drift * DRIFT_MARGIN, 1e-3))
+    top1_min = (args.top1_min if args.top1_min is not None
+                else max(0.5, agree - 0.05))
+    payload = save_calibration(
+        args.out, arch=args.arch, params=params,
+        stats={"top1_agreement": agree, "max_abs_dlogit": drift},
+        bounds={"max_abs_dlogit": drift_bound,
+                "min_top1_agreement": top1_min},
+        num_classes=args.num_classes, image_size=args.image_size,
+        sample_n=len(sample),
+    )
+    meta = payload["meta"]
+    print(
+        f"=> dptpu quantize: {args.arch} -> {args.out} "
+        f"(weights {meta['weights_fingerprint']}, sample "
+        f"{len(sample)}: top-1 agreement {agree:.3f}, max|dlogit| "
+        f"{drift:.3g}; gate bounds: agreement >= {top1_min:.3f}, "
+        f"drift <= {drift_bound:.3g})"
+    )
+    return meta
+
+
+def _calibration_sample(data, n: int, image_size: int):
+    """uint8 NHWC calibration batch: decoded val-pipeline rows from a
+    packed-shard/ImageFolder dir when given, else a deterministic
+    synthetic sample (load-test engines are random-init anyway — what
+    matters is that serve-time traffic statistics see the SAME scales
+    the gate bounds were measured with)."""
+    import numpy as np
+
+    if data is None:
+        rng = np.random.RandomState(0)
+        return rng.randint(
+            0, 256, (n, image_size, image_size, 3), np.uint8
+        )
+    from dptpu.serve.preprocess import preprocess_bytes
+
+    rows = []
+    for path in _iter_image_files(data):
+        with open(path, "rb") as f:
+            try:
+                rows.append(preprocess_bytes(f.read(), size=image_size))
+            except ValueError:
+                continue  # non-image file in the tree
+        if len(rows) >= n:
+            break
+    if not rows:
+        raise SystemExit(
+            f"--data {data}: no decodable images found for the "
+            f"calibration sample"
+        )
+    return np.stack(rows, axis=0)
+
+
+def _iter_image_files(root):
+    import os
+
+    for dirpath, _, files in sorted(os.walk(root)):
+        for f in sorted(files):
+            yield os.path.join(dirpath, f)
 
 
 def build_pack_parser():
@@ -312,15 +564,19 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: dptpu <subcommand> [args]\n\nsubcommands:\n"
-              "  serve   batched inference engine (dptpu/serve)\n"
-              "  pack    ImageFolder -> packed sequential shards "
+              "  serve     batched inference engine (dptpu/serve)\n"
+              "  quantize  offline int8 calibration -> CRC-sealed "
+              "artifact (dptpu/serve/quant.py)\n"
+              "  pack      ImageFolder -> packed sequential shards "
               "(dptpu/data/shards.py)\n"
-              "  check   repo-invariant static analysis: AST lints + "
+              "  check     repo-invariant static analysis: AST lints + "
               "HLO budget gates (dptpu/analysis)")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "serve":
         return main_serve(rest)
+    if cmd == "quantize":
+        return main_quantize(rest)
     if cmd == "pack":
         return main_pack(rest)
     if cmd == "check":
@@ -329,7 +585,7 @@ def main(argv=None):
         return main_check(rest)
     raise SystemExit(
         f"dptpu: unknown subcommand {cmd!r} "
-        f"(available: serve, pack, check)"
+        f"(available: serve, quantize, pack, check)"
     )
 
 

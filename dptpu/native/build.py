@@ -79,6 +79,14 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.c_double,
             ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
         ]
+        # fused serve-ingest: JPEG bytes -> val-pipeline pixels into a
+        # staging row, BIT-identical to the PIL path (probe-verified at
+        # first use by dptpu/serve/preprocess.py)
+        lib.dptpu_serve_ingest.restype = ctypes.c_int
+        lib.dptpu_serve_ingest.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ]
         # cold-epoch byte readahead: posix_fadvise(WILLNEED) the JPEG
         # files of pre-issued spans (parent-side, GIL released)
         lib.dptpu_file_readahead.restype = ctypes.c_longlong

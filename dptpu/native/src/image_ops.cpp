@@ -101,6 +101,182 @@ void crop_resize_bilinear(const uint8_t* src, int src_w, int src_h,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Pillow-exact bilinear box resample (the serve-ingest path).
+//
+// The serving contract (dptpu/serve/preprocess.py) is BIT-identity with the
+// PIL val pipeline — the pixels published accuracies were measured on. The
+// augmentation-grade kernel above (scaled decode + 2-tap lerp) trades that
+// for speed; this one replicates Pillow's ImagingResample for the BILINEAR
+// filter exactly: per-output-pixel normalized coefficient windows computed
+// in double, quantized to PRECISION_BITS fixed point with round-half-away,
+// a horizontal pass into a uint8 intermediate restricted to the vertical
+// window, then a vertical pass — including both uint8 quantization steps,
+// Pillow's clip8 saturation, and its pass-skip conditions, so the output
+// byte-matches img.resize((s, s), BILINEAR, box=...) on the same decode.
+namespace pillow_exact {
+
+constexpr int kPrecisionBits = 32 - 8 - 2;  // Pillow's PRECISION_BITS
+
+inline uint8_t clip8(int in) {
+  if (in >= (1 << (kPrecisionBits + 8))) return 255;
+  if (in <= 0) return 0;
+  return static_cast<uint8_t>(in >> kPrecisionBits);
+}
+
+inline double bilinear_filter(double x) {
+  if (x < 0.0) x = -x;
+  if (x < 1.0) return 1.0 - x;
+  return 0.0;
+}
+
+// Pillow's precompute_coeffs, verbatim semantics (support = 1.0 bilinear):
+// returns ksize, fills per-pixel [xmin, xmax) bounds and normalized double
+// weights (outSize x ksize). The box endpoints are SINGLE-precision and
+// their difference is subtracted in float before the double divide —
+// exactly Pillow's `(double)(in1 - in0) / outSize` with float args; doing
+// either step in double shifts coefficient windows by ~1e-7 px and flips
+// ±1 output LSBs (measured on the probe set).
+int precompute_coeffs(int in_size, float in0, float in1, int out_size,
+                      std::vector<int>* bounds, std::vector<double>* kk) {
+  double scale = static_cast<double>(in1 - in0) / out_size;
+  double filterscale = scale < 1.0 ? 1.0 : scale;
+  const double support = 1.0 * filterscale;
+  const int ksize = static_cast<int>(std::ceil(support)) * 2 + 1;
+  kk->assign(static_cast<size_t>(out_size) * ksize, 0.0);
+  bounds->assign(static_cast<size_t>(out_size) * 2, 0);
+  for (int xx = 0; xx < out_size; ++xx) {
+    const double center = in0 + (xx + 0.5) * scale;
+    const double ss = 1.0 / filterscale;
+    int xmin = static_cast<int>(center - support + 0.5);
+    if (xmin < 0) xmin = 0;
+    int xmax = static_cast<int>(center + support + 0.5);
+    if (xmax > in_size) xmax = in_size;
+    xmax -= xmin;
+    double* k = &(*kk)[static_cast<size_t>(xx) * ksize];
+    double ww = 0.0;
+    int x = 0;
+    for (; x < xmax; ++x) {
+      const double w = bilinear_filter((x + xmin - center + 0.5) * ss);
+      k[x] = w;
+      ww += w;
+    }
+    for (x = 0; x < xmax; ++x) {
+      if (ww != 0.0) k[x] /= ww;
+    }
+    for (; x < ksize; ++x) k[x] = 0.0;
+    (*bounds)[xx * 2 + 0] = xmin;
+    (*bounds)[xx * 2 + 1] = xmax;
+  }
+  return ksize;
+}
+
+// Pillow's normalize_coeffs_8bpc: round-half-away-from-zero into fixed point.
+void normalize_coeffs_8bpc(const std::vector<double>& prekk,
+                           std::vector<int>* kk) {
+  kk->resize(prekk.size());
+  for (size_t i = 0; i < prekk.size(); ++i) {
+    (*kk)[i] = prekk[i] < 0
+                   ? static_cast<int>(-0.5 + prekk[i] * (1 << kPrecisionBits))
+                   : static_cast<int>(0.5 + prekk[i] * (1 << kPrecisionBits));
+  }
+}
+
+// Horizontal pass: src rows [offset, offset + dst_h) -> dst (dst_w wide).
+void resample_horizontal(uint8_t* dst, int dst_w, int dst_h,
+                         const uint8_t* src, int src_w, int offset,
+                         int ksize, const std::vector<int>& bounds,
+                         const std::vector<int>& kk) {
+  for (int yy = 0; yy < dst_h; ++yy) {
+    const uint8_t* srow =
+        src + static_cast<size_t>(yy + offset) * src_w * 3;
+    uint8_t* drow = dst + static_cast<size_t>(yy) * dst_w * 3;
+    for (int xx = 0; xx < dst_w; ++xx) {
+      const int xmin = bounds[xx * 2], xmax = bounds[xx * 2 + 1];
+      const int* k = &kk[static_cast<size_t>(xx) * ksize];
+      int s0 = 1 << (kPrecisionBits - 1), s1 = s0, s2 = s0;
+      for (int x = 0; x < xmax; ++x) {
+        const uint8_t* p = srow + static_cast<size_t>(xmin + x) * 3;
+        s0 += p[0] * k[x];
+        s1 += p[1] * k[x];
+        s2 += p[2] * k[x];
+      }
+      drow[xx * 3 + 0] = clip8(s0);
+      drow[xx * 3 + 1] = clip8(s1);
+      drow[xx * 3 + 2] = clip8(s2);
+    }
+  }
+}
+
+// Vertical pass over the (already-horizontal) intermediate (width == dst_w).
+void resample_vertical(uint8_t* dst, int dst_w, int dst_h,
+                       const uint8_t* src, int ksize,
+                       const std::vector<int>& bounds,
+                       const std::vector<int>& kk) {
+  for (int yy = 0; yy < dst_h; ++yy) {
+    const int ymin = bounds[yy * 2], ymax = bounds[yy * 2 + 1];
+    const int* k = &kk[static_cast<size_t>(yy) * ksize];
+    uint8_t* drow = dst + static_cast<size_t>(yy) * dst_w * 3;
+    for (int xx = 0; xx < dst_w; ++xx) {
+      int s0 = 1 << (kPrecisionBits - 1), s1 = s0, s2 = s0;
+      for (int y = 0; y < ymax; ++y) {
+        const uint8_t* p =
+            src + (static_cast<size_t>(ymin + y) * dst_w + xx) * 3;
+        s0 += p[0] * k[y];
+        s1 += p[1] * k[y];
+        s2 += p[2] * k[y];
+      }
+      drow[xx * 3 + 0] = clip8(s0);
+      drow[xx * 3 + 1] = clip8(s1);
+      drow[xx * 3 + 2] = clip8(s2);
+    }
+  }
+}
+
+// ImagingResample for one fractional box -> out_size x out_size x 3,
+// including the pass-skip conditions (an identity axis is NOT resampled —
+// and therefore not re-quantized — exactly as in Pillow).
+int resample_box(const uint8_t* src, int src_w, int src_h, float bx0,
+                 float by0, float bx1, float by1, int out_size,
+                 uint8_t* out) {
+  const bool need_h = out_size != src_w || bx0 != 0.0f ||
+                      bx1 != static_cast<float>(out_size);
+  const bool need_v = out_size != src_h || by0 != 0.0f ||
+                      by1 != static_cast<float>(out_size);
+  std::vector<int> bounds_h, bounds_v, kkh, kkv;
+  std::vector<double> pre_h, pre_v;
+  const int ksize_h =
+      precompute_coeffs(src_w, bx0, bx1, out_size, &bounds_h, &pre_h);
+  const int ksize_v =
+      precompute_coeffs(src_h, by0, by1, out_size, &bounds_v, &pre_v);
+  normalize_coeffs_8bpc(pre_h, &kkh);
+  normalize_coeffs_8bpc(pre_v, &kkv);
+  // source rows the vertical filter will touch: the horizontal pass only
+  // materializes those.
+  const int ybox_first = bounds_v[0];
+  const int ybox_last =
+      bounds_v[out_size * 2 - 2] + bounds_v[out_size * 2 - 1];
+  if (need_h && need_v) {
+    for (int i = 0; i < out_size; ++i) bounds_v[i * 2] -= ybox_first;
+    std::vector<uint8_t> temp(static_cast<size_t>(out_size) *
+                              (ybox_last - ybox_first) * 3);
+    resample_horizontal(temp.data(), out_size, ybox_last - ybox_first, src,
+                        src_w, ybox_first, ksize_h, bounds_h, kkh);
+    resample_vertical(out, out_size, out_size, temp.data(), ksize_v,
+                      bounds_v, kkv);
+  } else if (need_h) {
+    resample_horizontal(out, out_size, out_size, src, src_w, 0, ksize_h,
+                        bounds_h, kkh);
+  } else if (need_v) {
+    resample_vertical(out, out_size, out_size, src, ksize_v, bounds_v, kkv);
+  } else {
+    std::memcpy(out, src, static_cast<size_t>(out_size) * out_size * 3);
+  }
+  return 0;
+}
+
+}  // namespace pillow_exact
+
 }  // namespace
 
 extern "C" {
@@ -275,6 +451,102 @@ int dptpu_jpeg_decode_crop_resize(const uint8_t* data, size_t size,
   crop_resize_bilinear(pixels.data(), dw, dh, crop_left * rx, crop_top * ry,
                        crop_w * rx, crop_h * ry, out_size, flip != 0, out);
   return 0;
+}
+
+// The fused serve-ingest kernel: request JPEG bytes -> the val pipeline's
+// uint8 pixels, straight into the caller's staging-ring row. One native
+// call replaces the PIL round trip (bytes -> PIL Image -> convert ->
+// box-resize -> np.asarray -> copyto), with no intermediate fp32 HWC
+// buffer anywhere: the resample runs in Pillow's own fixed-point integer
+// arithmetic and the output stays uint8 (normalization remains fused into
+// the compiled forward on device, exactly as on the PIL path).
+//
+// BIT-IDENTITY is the contract, not a goal: the decode uses PIL's own
+// libjpeg settings (full resolution, ISLOW DCT, fancy upsampling — the
+// library defaults PIL never overrides) and the resample replicates
+// ImagingResample exactly (pillow_exact above); Resize(resize) +
+// CenterCrop(out_size) is folded to the same fractional box the Python
+// side computes (center_fit_box, dptpu/data/transforms.py — integer math
+// reproduced here 1:1). The Python wrapper PROVES the identity at first
+// use with a probe against the PIL path and falls back loudly on any
+// mismatch, so a foreign libjpeg can never silently change served pixels.
+//
+// Returns 0 on success; negative = caller must take the PIL path
+// (non-JPEG container, CMYK/YCCK color — PIL's CMYK->RGB convert is not
+// a libjpeg conversion — or corrupt bytes).
+int dptpu_serve_ingest(const uint8_t* data, size_t size, int out_size,
+                       int resize, uint8_t* out) {
+  if (out_size <= 0 || resize <= 0) return -3;
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  std::vector<uint8_t> pixels;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, size);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  if (cinfo.jpeg_color_space == JCS_CMYK ||
+      cinfo.jpeg_color_space == JCS_YCCK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -5;  // PIL's CMYK handling is its own convert; don't imitate
+  }
+  // PIL's decode settings exactly: no scaling, ISLOW, fancy upsampling
+  // (the last two are the libjpeg defaults PIL leaves untouched);
+  // grayscale -> RGB replication matches PIL's L -> RGB convert.
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.dct_method = JDCT_ISLOW;
+  jpeg_start_decompress(&cinfo);
+  const int w = static_cast<int>(cinfo.output_width);
+  const int h = static_cast<int>(cinfo.output_height);
+  if (w <= 0 || h <= 0) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -6;
+  }
+  pixels.resize(static_cast<size_t>(w) * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row =
+        pixels.data() + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  // center_fit_box(w, h, out_size, resize), integer-for-integer: Python's
+  // int() on a true division is C's (int) on the same double; // 2 on a
+  // possibly-negative margin is floor, not truncation.
+  int nw, nh;
+  if (w <= h) {
+    nw = resize;
+    nh = static_cast<int>(static_cast<double>(resize) * h / w);
+  } else {
+    nh = resize;
+    nw = static_cast<int>(static_cast<double>(resize) * w / h);
+  }
+  const double sx = w / static_cast<double>(nw);
+  const double sy = h / static_cast<double>(nh);
+  const int left =
+      static_cast<int>(std::floor((nw - out_size) / 2.0));
+  const int top =
+      static_cast<int>(std::floor((nh - out_size) / 2.0));
+  // PIL parses the resize box as C float (32-bit) — "(ffff)" in
+  // _imaging.c — so the box coordinates are float-quantized BEFORE the
+  // coefficient windows are computed. Bit-identity requires the same
+  // quantization here; keeping doubles shifts windows by ~1e-7 px and
+  // flips ±1 LSBs (measured: 0.2% of pixels on the probe set).
+  const float bx0 = static_cast<float>(left * sx);
+  const float by0 = static_cast<float>(top * sy);
+  const float bx1 = static_cast<float>(left * sx + out_size * sx);
+  const float by1 = static_cast<float>(top * sy + out_size * sy);
+  return pillow_exact::resample_box(pixels.data(), w, h, bx0, by0, bx1,
+                                    by1, out_size, out);
 }
 
 }  // extern "C"

@@ -1,0 +1,160 @@
+"""Per-channel weight quantization primitives for the serve fast path.
+
+Post-training, weight-only: matmul/conv kernels (any param leaf with
+``ndim >= 2``) are stored int8 with one fp32 scale per OUTPUT channel
+(last axis — Flax kernels are ``(..., in, out)``); everything rank-0/1
+(biases, norm scales/offsets, layer_scale) stays fp32 — those leaves
+are a rounding error of the residency bill and quantizing norms is
+where PTQ accuracy actually dies. Compute dequantizes in-graph to
+bf16, so the compiled forward carries ``s8`` parameters and ``bf16``
+dots (asserted from HLO by the serve-quant budget config in ``dptpu
+check`` — a silent fp32 fallback fails statically).
+
+The scheme is symmetric absmax: ``scale = max|w_channel| / 127``,
+``q = round(w / scale)`` — zero-point-free, so dequantization is one
+multiply. Scales are computed offline by ``dptpu quantize`` and travel
+in the CRC-sealed calibration artifact (dptpu/serve/quant.py), NOT
+recomputed at load: the artifact is the provenance record that ties a
+quantized deployment to the exact weights it was calibrated against.
+
+Quantized trees keep the original nesting but each quantized leaf
+becomes a ``{"q": int8, "scale": fp32}`` marker dict — walkable by the
+same recursion everywhere (:func:`is_quantized_leaf`), and a pytree
+jax can place/donate like any other.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Symmetric int8: the full signed range less -128 (absmax maps to +/-127
+# exactly; keeping the range symmetric makes q = -q for w = -w).
+QMAX = 127.0
+
+# A channel of exact zeros gets scale EPS instead of 0 so dequantize is
+# division-free and never NaNs; its q values are all 0 either way.
+_SCALE_EPS = 1e-12
+
+
+def quantizable(leaf) -> bool:
+    """True for leaves that take per-channel int8: real matmul/conv
+    kernels (``ndim >= 2``). Rank-0/1 leaves (bias/norm/scale) pass
+    through fp32."""
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+
+def channel_scales(w) -> np.ndarray:
+    """fp32 absmax scale per last-axis (output) channel, shape
+    ``w.shape[-1:]`` broadcast-ready against ``w``."""
+    a = np.asarray(w, np.float32)
+    reduce_axes = tuple(range(a.ndim - 1))
+    s = np.max(np.abs(a), axis=reduce_axes) / QMAX
+    return np.maximum(s, _SCALE_EPS).astype(np.float32)
+
+
+def quantize_leaf(w, scale=None) -> Tuple[np.ndarray, np.ndarray]:
+    """``(q_int8, scale_fp32)`` for one kernel leaf. ``scale`` from a
+    calibration artifact wins; absent, it is computed from ``w``."""
+    a = np.asarray(w, np.float32)
+    if scale is None:
+        scale = channel_scales(a)
+    scale = np.asarray(scale, np.float32)
+    q = np.clip(np.rint(a / scale), -QMAX, QMAX).astype(np.int8)
+    return q, scale
+
+
+def dequantize_leaf(q, scale, dtype=jnp.bfloat16):
+    """In-graph dequantize: one convert + one broadcast multiply. Scales
+    multiply in fp32 THEN cast — quantization error stays the rounding
+    of q, not compounded by a bf16 scale."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def is_quantized_leaf(node) -> bool:
+    """A ``{"q": ..., "scale": ...}`` marker dict produced by
+    :func:`quantize_tree`."""
+    return (isinstance(node, dict) and set(node) == {"q", "scale"}
+            and hasattr(node["q"], "dtype"))
+
+
+def quantize_tree(params: dict, scales: dict = None) -> dict:
+    """Quantize a (nested-dict) param tree: quantizable leaves become
+    ``{"q", "scale"}`` markers, the rest pass through as fp32 np arrays.
+    ``scales`` (same nesting, leaves = per-channel scale arrays or None)
+    comes from the calibration artifact; None recomputes from weights.
+    """
+    def walk(node, snode):
+        if isinstance(node, dict):
+            return {k: walk(v, None if snode is None else snode.get(k))
+                    for k, v in node.items()}
+        if quantizable(node):
+            if snode is not None and getattr(snode, "size", 1) == 0:
+                snode = None  # placeholder row: recompute (deterministic)
+            q, s = quantize_leaf(node, snode)
+            return {"q": q, "scale": s}
+        return np.asarray(node, np.float32)
+
+    return walk(params, scales)
+
+
+def scales_tree(params: dict) -> dict:
+    """The calibration payload: same nesting as ``params``, quantizable
+    leaves carry their per-channel fp32 scales, others an empty fp32
+    array (msgpack-serializable placeholder — ``quantize_tree`` treats
+    size-0 as 'recompute', but absmax scales are deterministic so the
+    placeholder never matters in practice)."""
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if quantizable(node):
+            return channel_scales(node)
+        return np.zeros((0,), np.float32)
+
+    return walk(params)
+
+
+def dequantize_tree(qparams: dict, dtype=jnp.bfloat16):
+    """The in-forward walk: marker leaves dequantize to ``dtype``, fp32
+    passthrough leaves are left untouched (norms/bias stay fp32 — mixed
+    precision exactly like the bf16 train step keeps its norm params)."""
+    def walk(node):
+        if is_quantized_leaf(node):
+            return dequantize_leaf(node["q"], node["scale"], dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)
+
+
+def cast_tree(params: dict, dtype=jnp.bfloat16) -> dict:
+    """The bf16 precision arm: quantizable (matmul) leaves cast to
+    ``dtype`` for residency + compute, rank-0/1 leaves stay fp32."""
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if quantizable(node):
+            return np.asarray(node, dtype)
+        return np.asarray(node, np.float32)
+
+    return walk(params)
+
+
+def tree_nbytes(tree) -> int:
+    """Resident bytes of a (possibly quantized) variables tree — the
+    HBM-residency meter SERVEBENCH's quantized arm reports."""
+    total = 0
+
+    def walk(node):
+        nonlocal total
+        if isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+        elif hasattr(node, "nbytes"):
+            total += int(node.nbytes)
+
+    walk(tree)
+    return total

@@ -241,6 +241,36 @@ def donated_alias_count(hlo_text: str) -> int:
     return 0
 
 
+_DOT_RE = re.compile(
+    r"= (\w+)\[[\d,]*\][^ ]* (?:dot|convolution)\("
+)
+_S8_PARAM_RE = re.compile(r"= s8\[[\d,]*\][^ ]* parameter\(")
+
+
+def dot_dtype_census(hlo_text: str) -> dict:
+    """Requested dtypes of the program's matmul work — the serve-quant
+    budget gate's raw numbers: per-result-dtype counts of every dot /
+    convolution instruction, plus the count of ``s8`` parameters (the
+    weights that actually travel int8). Run on PRE-OPTIMIZATION HLO
+    (``lowered.compiler_ir(dialect="hlo").as_hlo_text()``): this
+    container's CPU backend has no bf16 gemm kernels, so its float
+    normalization pass rewrites every bf16 dot as convert-to-f32 +
+    f32 dot before the optimized text exists — the REQUESTED compute
+    dtype (what a TPU backend would execute) is only observable
+    pre-optimization."""
+    import collections
+
+    dots = collections.Counter()
+    for line in hlo_text.splitlines():
+        m = _DOT_RE.search(line)
+        if m:
+            dots[m.group(1)] += 1
+    return {
+        "dots": dict(dots),
+        "s8_params": len(_S8_PARAM_RE.findall(hlo_text)),
+    }
+
+
 # opcodes that represent real math in the scheduled entry computation —
 # the "backward computation" the overlap evidence counts between
 # reduction collectives (fusions cover almost everything post-fusion;

@@ -31,6 +31,18 @@ vertical:
 * :class:`ModelRouter` (router.py) — N co-resident engines (different
   archs and/or generations) behind one submit/readiness surface, each
   with its own queue, ladder and admission gate.
+* quantized fast path (quant.py + dptpu/ops/quant.py) — weight-only
+  int8 (per-channel absmax) and bf16 serve precisions behind a
+  CRC-sealed, provenance-stamped calibration artifact (``dptpu
+  quantize``); the engine's bucket ladder gains a precision axis and
+  the canary gate enforces the artifact's logit-drift bounds online —
+  a drifting quantized rollout auto-rolls-back, never serves silently.
+* :class:`FleetRouter` / :class:`FleetMember` (fleet.py) — the
+  multi-host tier (``dptpu serve --fleet``): membership + heartbeats
+  over the quorum KV transport, auto-drain of dead hosts on the
+  heartbeat verdict, least-loaded routing with connection-death
+  failover (zero failed in-flight requests when a host dies), fleet-
+  wide admission at the front door.
 * knob contract (knobs.py) + stdlib HTTP listener (http.py — liveness
   ``/healthz``, readiness ``/readyz``, ``/predict[/<model>]`` with
   priority/deadline headers) behind the ``dptpu serve`` CLI subcommand
@@ -82,6 +94,10 @@ __all__ = [
     "ServeCancelled", "DeadlineExceeded", "CanaryController",
     "ModelRouter", "ServedModel", "build_served_model",
     "resolve_placement",
+    "CalibrationError", "load_calibration", "save_calibration",
+    "quantize_variables", "measure_drift", "weights_fingerprint",
+    "FleetMember", "FleetRouter", "FleetUnavailable",
+    "serve_fleet_forever",
 ]
 
 
@@ -106,4 +122,15 @@ def __getattr__(name):
         from dptpu.serve import router
 
         return getattr(router, name)
+    if name in ("CalibrationError", "load_calibration",
+                "save_calibration", "quantize_variables",
+                "measure_drift", "weights_fingerprint"):
+        from dptpu.serve import quant
+
+        return getattr(quant, name)
+    if name in ("FleetMember", "FleetRouter", "FleetUnavailable",
+                "serve_fleet_forever"):
+        from dptpu.serve import fleet
+
+        return getattr(fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -23,6 +23,15 @@ gated rollout:
   **auto-rollback**. A canary batch-latency EWMA above
   ``DPTPU_SERVE_CANARY_LAT_FACTOR`` × baseline rolls back too.
 
+* **Quantized rollouts** (ISSUE 18): ``start_quantized`` stages an
+  int8/bf16 generation through the engine's artifact-verified front
+  door and ARMS the gate with the artifact's own bounds — per-rollout
+  ``max|Δlogit|`` AND a cumulative **top-1 agreement** floor over the
+  shadow-evaluated rows (quantization error that flips argmax is a
+  serving regression even when every |Δlogit| is individually small).
+  Both verdicts are loud; a drifting quantized generation rolls back
+  exactly like a bad weight push, never silently.
+
 * Rollback is LOUD (stderr + ``Serve/canary_rollbacks`` counter) and
   clean: :meth:`discard_staged` drops the stager's pin, in-flight
   canary batches drain on their pinned generation (the mixed-generation
@@ -61,16 +70,23 @@ class CanaryController:
 
     def __init__(self, engine, *, fraction: float = 0.1,
                  drift_limit: float = 50.0, lat_factor: float = 5.0,
-                 min_batches: int = 8, fault_plan=None):
+                 min_batches: int = 8, min_top1_agreement: float = 0.0,
+                 fault_plan=None):
         if not 0.0 < fraction < 1.0:
             raise ValueError(
                 f"canary fraction {fraction} must be in (0, 1)"
+            )
+        if not 0.0 <= min_top1_agreement <= 1.0:
+            raise ValueError(
+                f"min_top1_agreement {min_top1_agreement} must be in "
+                f"[0, 1]"
             )
         self.engine = engine
         self.fraction = fraction
         self.drift_limit = drift_limit
         self.lat_factor = lat_factor
         self.min_batches = min_batches
+        self.min_top1_agreement = min_top1_agreement
         self._plan = fault_plan
         self._lock = OrderedLock("serve.canary")
         self._state = "idle"  # guarded-by: _lock
@@ -85,6 +101,12 @@ class CanaryController:
         self._max_drift = 0.0  # guarded-by: _lock
         self._rollbacks = 0  # guarded-by: _lock
         self._rollback_reason = ""  # guarded-by: _lock
+        # per-rollout gate bounds (quantized rollouts arm these from
+        # the calibration artifact; start() uses the constructor's)
+        self._active_drift = drift_limit  # guarded-by: _lock
+        self._active_top1 = min_top1_agreement  # guarded-by: _lock
+        self._agree_rows = 0  # guarded-by: _lock
+        self._total_rows = 0  # guarded-by: _lock
         self._q: queue.Queue = queue.Queue()
         self._eval_thread = threading.Thread(
             target=self._eval_loop, name="dptpu-serve-canary",
@@ -107,6 +129,38 @@ class CanaryController:
             )
         base = self.engine.current_generation
         gen = self.engine.stage_weights(variables)
+        self._begin(gen, base, self.drift_limit, self.min_top1_agreement)
+        return gen
+
+    def start_quantized(self, calibration: str, precision: str = "int8",
+                        drift_limit: Optional[float] = None,
+                        top1_min: Optional[float] = None) -> int:
+        """Stage a QUANTIZED canary through the engine's
+        artifact-verified front door and arm the gate with the
+        artifact's bounds (``meta["bounds"]``: ``max_abs_dlogit``,
+        ``min_top1_agreement`` — stated at calibration time, enforced
+        online here). Explicit ``drift_limit``/``top1_min`` (the
+        ``DPTPU_QUANT_DRIFT``/``DPTPU_QUANT_TOP1_MIN`` operator
+        overrides) win over the artifact. Returns the staged id."""
+        gen, meta = self.engine.stage_quantized(
+            calibration, precision=precision
+        )
+        bounds = meta.get("bounds", {})
+        if drift_limit is None:
+            drift_limit = float(
+                bounds.get("max_abs_dlogit", self.drift_limit)
+            )
+        if top1_min is None:
+            top1_min = float(
+                bounds.get("min_top1_agreement",
+                           self.min_top1_agreement)
+            )
+        self._begin(gen, self.engine.current_generation,
+                    float(drift_limit), float(top1_min))
+        return gen
+
+    def _begin(self, gen: int, base: int, drift_limit: float,
+               top1_min: float) -> None:
         with self._lock:
             if self._state == "canary":
                 # a rollout is already live: discard the new stage
@@ -125,7 +179,10 @@ class CanaryController:
             self._clean_evals = 0
             self._max_drift = 0.0
             self._rollback_reason = ""
-        return gen
+            self._active_drift = drift_limit
+            self._active_top1 = top1_min
+            self._agree_rows = 0
+            self._total_rows = 0
 
     def pick_generation(self) -> int:
         """Choose + PIN the generation for one batch (the batcher calls
@@ -210,15 +267,35 @@ class CanaryController:
         drift = float(np.max(np.abs(
             base_logits[:n] - canary_logits[:n]
         )))
+        agree = int(np.sum(
+            base_logits[:n].argmax(-1) == canary_logits[:n].argmax(-1)
+        ))
         with self._lock:
             if self._state != "canary" or gen != self._canary_gen:
                 return
             if drift > self._max_drift:
                 self._max_drift = drift
-            if drift > self.drift_limit:
+            self._agree_rows += agree
+            self._total_rows += n
+            if drift > self._active_drift:
                 self._rollback_locked(
                     f"logit drift {drift:.3g} > limit "
-                    f"{self.drift_limit:.3g}"
+                    f"{self._active_drift:.3g}"
+                )
+                return
+            # top-1 agreement is CUMULATIVE over shadow-evaled rows (a
+            # single flipped row in a tiny batch is sampling noise; a
+            # persistent deficit is drift) — judged once enough rows
+            # accumulated, and again at promotion time
+            if (self._active_top1 > 0.0
+                    and self._total_rows >= self.min_batches
+                    and self._agree_rows
+                    < self._active_top1 * self._total_rows):
+                self._rollback_locked(
+                    f"top-1 agreement "
+                    f"{self._agree_rows / self._total_rows:.3f} "
+                    f"({self._agree_rows}/{self._total_rows} rows) < "
+                    f"floor {self._active_top1:.3f}"
                 )
                 return
             self._clean_evals += 1
@@ -241,6 +318,10 @@ class CanaryController:
         self.engine.discard_staged(gen)
 
     def _maybe_promote_locked(self):
+        if (self._active_top1 > 0.0 and self._total_rows > 0
+                and self._agree_rows
+                < self._active_top1 * self._total_rows):
+            return  # agreement deficit: never promote past the floor
         if (self._clean_evals >= self.min_batches
                 and self._canary_batches >= self.min_batches):
             self.engine.promote(self._canary_gen)
@@ -276,6 +357,13 @@ class CanaryController:
                 "base_batches": self._base_batches,
                 "clean_evals": self._clean_evals,
                 "max_drift": self._max_drift,
+                "drift_limit": self._active_drift,
+                "top1_floor": self._active_top1,
+                "top1_agreement": (
+                    self._agree_rows / self._total_rows
+                    if self._total_rows else None
+                ),
+                "shadow_rows": self._total_rows,
                 "canary_ms": self._canary_ms,
                 "base_ms": self._base_ms,
                 "rollbacks": self._rollbacks,

@@ -52,6 +52,27 @@ Design (ISSUE 7 tentpole):
   serving is structurally impossible — one pytree per call), and a
   superseded generation's buffers are dropped the moment its last
   in-flight batch releases (``old generation drains``).
+
+* **Precision axis** (ISSUE 18) — the bucket ladder is compiled per
+  PRECISION: ``_compiled[(precision, nexec)]``. ``fp32`` is the base
+  ladder (today's path); ``bf16`` stores matmul weights bf16; ``int8``
+  stores them int8 with per-channel scales (dptpu/ops/quant.py) and
+  dequantizes in-graph to bf16 — the compiled HLO carries ``s8``
+  params and ``bf16`` dots (statically asserted by the serve-quant
+  budget config in ``dptpu check``). Each weight GENERATION carries
+  its precision, so a quantized rollout is just a staged generation:
+  it rides the canary machinery (shadow eval, top-1 agreement +
+  max|Δlogit| gate, auto-rollback) and is NEVER silently promoted —
+  ``stage_quantized`` also refuses to run without a verified
+  calibration artifact (CRC + arch + weights-fingerprint match).
+
+* **Per-shard TP loading** — under ``tp`` placement, weights are
+  constructed shard-by-shard from the unified partition-rules
+  projection (``jax.make_array_from_callback``: each device's shard is
+  sliced from the host array on demand) instead of gathering the full
+  array onto every device and resharding — the serve twin of the
+  rules-table unification, locked at max|Δlogit| = 0 against the
+  gathered path.
 """
 
 from __future__ import annotations
@@ -134,6 +155,9 @@ class ServeEngine:
         self.model = create_model(
             arch, pretrained=pretrained, num_classes=num_classes
         )
+        # built lazily at first sub-fp32 stage; duplicate off-lock
+        # builds produce identical clones, so last-write-wins is benign
+        self._bf16_model_cache = None  # dptpu: allow-guarded-by(idempotent lazy clone; racing stagers rebuild an identical module)
         self.placement = resolve_placement(arch, placement)
         input_shape = (1, image_size, image_size, 3)
         if variables is None:
@@ -152,6 +176,12 @@ class ServeEngine:
                              "batch_stats": init.get("batch_stats", {})}
         variables = {"params": variables["params"],
                      "batch_stats": variables.get("batch_stats", {})}
+        # host-side fp32 copy: the quantization source (stage_quantized
+        # fingerprints + quantizes THESE exact weights) — one host copy,
+        # never on device
+        self._host_variables = jax.tree_util.tree_map(
+            np.asarray, variables
+        )
 
         self._mesh = None
         self._var_shardings = None
@@ -190,23 +220,14 @@ class ServeEngine:
         self._latest = 1  # guarded-by: _lock
         self._weights: Dict[int, dict] = {1: self._place(variables)}  # guarded-by: _lock
         self._inflight: Dict[int, int] = {1: 0}  # guarded-by: _lock
+        self._precision: Dict[int, str] = {1: "fp32"}  # guarded-by: _lock
+        self._verbose = verbose
 
-        # AOT compile the ladder (dedup buckets that share an exec size:
-        # 1 and 2 both execute at the floor)
-        self._compiled = {}
-        var_structs = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            self._weights[1],
-        )
-        for b in self.buckets:
-            nexec = self.exec_batch(b)
-            if nexec in self._compiled:
-                continue
-            with obs.get_tracer().span("serve_compile"):
-                self._compiled[nexec] = self._compile_at(nexec, var_structs)
-            if verbose:
-                print(f"=> serve: AOT-compiled {arch} bucket {b} "
-                      f"(exec batch {nexec}, {self.placement})")
+        # AOT compile the base ladder (dedup buckets that share an exec
+        # size: 1 and 2 both execute at the floor); further precision
+        # ladders compile lazily at first stage of that precision
+        self._compiled = {}  # {(precision, nexec): executable}  # dptpu: allow-guarded-by(idempotent compile cache mutated off-lock by design; concurrent stagers race to identical executables and dict stores are atomic)
+        self._compile_ladder("fp32", self._weights[1])
 
     # -- compilation ----------------------------------------------------
 
@@ -217,20 +238,79 @@ class ServeEngine:
         out = self.model.apply(variables, x, train=False)
         return out.astype(jnp.float32)
 
-    def _compile_at(self, nexec: int, var_structs):
+    def _forward_int8(self, qvariables, images):
+        from dptpu.ops.quant import dequantize_tree
+        from dptpu.train.step import normalize_images
+
+        # in-graph dequantize: weights STAY int8 in device memory (the
+        # residency win); the convert+scale fuses into the consumer and
+        # every dot runs bf16
+        variables = {
+            "params": dequantize_tree(qvariables["params"], jnp.bfloat16),
+            "batch_stats": qvariables["batch_stats"],
+        }
+        x = normalize_images(images, jnp.bfloat16)
+        out = self._bf16_model().apply(variables, x, train=False)
+        return out.astype(jnp.float32)
+
+    def _forward_bf16(self, variables, images):
+        from dptpu.train.step import normalize_images
+
+        x = normalize_images(images, jnp.bfloat16)
+        out = self._bf16_model().apply(variables, x, train=False)
+        return out.astype(jnp.float32)
+
+    def _bf16_model(self):
+        """The model at compute dtype bf16 — the sub-fp32 forwards MUST
+        apply this twin, not ``self.model``: every registry module casts
+        activations to its own ``dtype`` attribute (fp32 here), so
+        applying the fp32 module would silently promote every dot back
+        to f32 and keep only the residency win. The serve-quant HLO
+        budget gate (`dptpu check`) asserts the requested dot dtypes
+        statically, so that regression fails before any bench."""
+        if self._bf16_model_cache is None:
+            self._bf16_model_cache = self.model.clone(dtype=jnp.bfloat16)
+        return self._bf16_model_cache
+
+    def _forward_for(self, precision: str):
+        return {"fp32": self._forward, "bf16": self._forward_bf16,
+                "int8": self._forward_int8}[precision]
+
+    def _compile_ladder(self, precision: str, placed_variables) -> None:
+        """AOT-compile every bucket of the ladder at ``precision`` from
+        a placed variables tree (idempotent; races between concurrent
+        stagers install identical executables)."""
+        var_structs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            placed_variables,
+        )
+        for b in self.buckets:
+            nexec = self.exec_batch(b)
+            if (precision, nexec) in self._compiled:
+                continue
+            with obs.get_tracer().span("serve_compile"):
+                exe = self._compile_at(nexec, var_structs, precision)
+            self._compiled[(precision, nexec)] = exe
+            if self._verbose:
+                print(f"=> serve: AOT-compiled {self.arch} bucket {b} "
+                      f"(exec batch {nexec}, {self.placement}, "
+                      f"{precision})")
+
+    def _compile_at(self, nexec: int, var_structs, precision: str = "fp32"):
         img = jax.ShapeDtypeStruct(
             (nexec, self.image_size, self.image_size, 3), jnp.uint8
         )
+        forward = self._forward_for(precision)
         if self.placement == "tp":
             fn = jax.jit(
-                self._forward,
+                forward,
                 in_shardings=(self._var_shardings, self._img_sharding),
                 out_shardings=self._out_sharding,
                 compiler_options=serve_compiler_options(),
             )
         else:
             fn = jax.jit(
-                self._forward, compiler_options=serve_compiler_options()
+                forward, compiler_options=serve_compiler_options()
             )
         return fn.lower(var_structs, img).compile()
 
@@ -256,6 +336,29 @@ class ServeEngine:
 
     def _place(self, variables):
         if self.placement == "tp":
+            # per-shard construction from the rules projection: each
+            # device's addressable shard is SLICED from the host array
+            # by the callback — the full array is never gathered onto
+            # any device and then resharded (the old device_put path).
+            # Bit-identical to the gathered path (same host values,
+            # same final layout) — locked at max|Δlogit| = 0 by
+            # tests/test_serve.py.
+            def put(x, s):
+                a = np.asarray(x)
+                return jax.make_array_from_callback(
+                    a.shape, s, lambda idx, _a=a: _a[idx]
+                )
+
+            return jax.tree_util.tree_map(
+                put, variables, self._var_shardings,
+            )
+        return jax.device_put(variables)
+
+    def _place_gathered(self, variables):
+        """The pre-rules-projection placement (gather the full array to
+        every device, let the sharding reshard) — kept ONLY as the = 0
+        parity reference for the per-shard path."""
+        if self.placement == "tp":
             return jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(np.asarray(x), s),
                 variables, self._var_shardings,
@@ -276,26 +379,63 @@ class ServeEngine:
             self._gen = self._latest
             self._weights[self._gen] = placed
             self._inflight[self._gen] = 0
+            self._precision[self._gen] = "fp32"
             self._drop_drained_locked()
             return self._gen
 
-    def stage_weights(self, variables) -> int:
+    def stage_weights(self, variables, precision: str = "fp32") -> int:
         """Install a new generation WITHOUT making it current (the
         canary rollout's first half): the generation is resident and
         pinnable via ``acquire_generation(gen=...)``, but default
         traffic keeps serving the current one. The staged generation
         starts with ONE in-flight pin — the stager's — so draining
         cannot drop it before ``promote`` or ``discard_staged`` decides
-        its fate. Returns the staged id."""
+        its fate. ``precision`` != fp32 expects an ALREADY-converted
+        tree (``stage_quantized`` is the artifact-verified front door)
+        and lazily compiles that precision's ladder. Returns the staged
+        id."""
         variables = {"params": variables["params"],
                      "batch_stats": variables.get("batch_stats", {})}
+        if precision != "fp32" and self.placement == "tp":
+            raise ValueError(
+                f"precision {precision!r} is not supported under tp "
+                f"placement (quantized marker leaves have no sharding "
+                f"rule projection yet) — serve quantized models "
+                f"replicated"
+            )
         placed = self._place(variables)  # off-lock: device transfer
+        self._compile_ladder(precision, placed)  # off-lock: idempotent
         with self._lock:
             self._latest += 1
             gen = self._latest
             self._weights[gen] = placed
             self._inflight[gen] = 1  # the stager's pin
+            self._precision[gen] = precision
             return gen
+
+    def stage_quantized(self, calibration: str, precision: str = "int8"):
+        """The quantized rollout's front door: verify the calibration
+        artifact against THIS engine's arch and live weights (CRC +
+        arch + weights fingerprint — dptpu/serve/quant.py names the
+        recalibration command on any mismatch), quantize the host-side
+        fp32 weights with the artifact's scales, and stage the result
+        as a new generation. Returns ``(gen, meta)`` — ``meta`` carries
+        the gate bounds the canary controller must enforce
+        (``meta["bounds"]``: min top-1 agreement, max|Δlogit|). bf16
+        precision needs no scales; the artifact is still required so
+        every sub-fp32 deployment has a provenance record."""
+        from dptpu.serve.quant import load_calibration, quantize_variables
+
+        payload = load_calibration(
+            calibration, arch=self.arch,
+            params=self._host_variables["params"],
+        )
+        qvars = quantize_variables(
+            self._host_variables, precision,
+            scales=payload.get("scales") if precision == "int8" else None,
+        )
+        gen = self.stage_weights(qvars, precision=precision)
+        return gen, payload["meta"]
 
     def promote(self, gen: int) -> None:
         """Make a staged generation CURRENT (the canary rollout's happy
@@ -346,6 +486,7 @@ class ServeEngine:
                   if g != self._gen and self._inflight[g] == 0]:
             del self._weights[g]
             del self._inflight[g]
+            del self._precision[g]
 
     def generations(self) -> Tuple[int, ...]:
         """Live (resident) generation ids — newest is current; older
@@ -357,6 +498,21 @@ class ServeEngine:
     def current_generation(self) -> int:
         with self._lock:
             return self._gen
+
+    def generation_precision(self, gen: Optional[int] = None) -> str:
+        """The precision axis of a resident generation (default: the
+        current one)."""
+        with self._lock:
+            return self._precision[self._gen if gen is None else gen]
+
+    def resident_bytes(self) -> Dict[int, int]:
+        """Per-generation resident weight bytes — the HBM-residency
+        meter SERVEBENCH's quantized arm reports (int8 matmul weights
+        are 4x smaller than their fp32 generation)."""
+        from dptpu.ops.quant import tree_nbytes
+
+        with self._lock:
+            return {g: tree_nbytes(w) for g, w in self._weights.items()}
 
     # -- execution ------------------------------------------------------
 
@@ -381,8 +537,11 @@ class ServeEngine:
         try:
             with self._lock:
                 weights = self._weights[gen]
+                precision = self._precision[gen]
             with obs.get_tracer().span("serve_device"):
-                out = self._compiled[nexec](weights, images_exec)
+                out = self._compiled[(precision, nexec)](
+                    weights, images_exec
+                )
                 logits = np.asarray(out)  # blocks: device done with input
         finally:
             if owns_gen:

@@ -1,0 +1,437 @@
+"""Multi-host serve fleet: membership, heartbeat verdicts, failover.
+
+ISSUE 18 tentpole (c). One serving host is one failure domain; the
+fleet tier turns N of them into one front:
+
+* **Membership over the quorum KV transport** — each ``dptpu serve``
+  host with a fleet dir configured registers a ``serve-host-<id>`` key
+  (endpoint + pid, written once) in a :class:`~dptpu.resilience.quorum
+  .FileKVStore` directory and then heartbeats ``serve-beat-<id>``
+  (timestamp + a load snapshot read from the host's own metrics
+  registry) on a dedicated thread — the elastic-training membership
+  recipe (``dptpu/resilience/quorum.py``) reused verbatim: atomic
+  single-file writes, wall-clock staleness verdicts, no coordinator.
+
+* **Auto-drain on the heartbeat verdict** — the fleet router's poll
+  thread re-scans membership every beat period; a member whose last
+  beat is older than ``DPTPU_FLEET_DEADLINE_S`` (or who wrote a
+  ``draining`` tombstone on clean shutdown) is REMOVED from the route
+  table, loudly (stderr + ``Fleet/drains`` counter). A host that
+  resumes beating re-enters the table on the next poll — drain is a
+  routing verdict, not an expulsion.
+
+* **Zero failed in-flight requests** — a forwarded request whose
+  member connection dies (the host was killed mid-request) is retried
+  on another healthy member up to ``DPTPU_FLEET_RETRIES`` times; the
+  inference POST is idempotent, so failover is safe by construction.
+  Together with the drain verdict this is the acceptance property:
+  killing a host mid-load costs latency on the requests it was
+  holding, never an error surfaced to a client.
+
+* **Admission fronts the whole fleet** — the PR-17
+  :class:`~dptpu.serve.admission.AdmissionController` runs in the
+  front with fleet-wide water marks: saturation sheds with 503 +
+  Retry-After at the door instead of queueing on a dying member.
+
+Lock order: ``serve.fleet`` (rank 12) guards only the route table and
+per-member in-flight counts; it never nests with the admission (15) or
+engine (20) locks — forwarding happens entirely off-lock.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from dptpu import obs
+from dptpu.serve.admission import AdmissionController, AdmissionError
+from dptpu.utils.sync import OrderedLock, StopToken
+
+MEMBER_PREFIX = "serve-host-"
+BEAT_PREFIX = "serve-beat-"
+
+# metrics-registry scalars summarized into each beat (the router reads
+# load from the member's OWN registry, not from probing it)
+_LOAD_KEYS = ("Serve/completed", "Admission/admitted", "Admission/shed")
+
+
+class FleetUnavailable(AdmissionError):
+    """No healthy member can take this request right now."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, status=503, retry_after_s=1.0)
+
+
+class FleetMember:
+    """One serving host's fleet presence: a registration record plus a
+    heartbeat thread (``dptpu-serve-fleet-beat``) stamping liveness and
+    a load snapshot from this process's metrics registry."""
+
+    def __init__(self, directory: str, *, host: str, port: int,
+                 member_id: Optional[str] = None,
+                 heartbeat_s: float = 1.0, load_fn=None):
+        from dptpu.resilience.quorum import FileKVStore
+
+        self.store = FileKVStore(directory)
+        self.member_id = member_id or (
+            f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        )
+        self.endpoint = (host, int(port))
+        self.heartbeat_s = float(heartbeat_s)
+        self._load_fn = load_fn or self._registry_load
+        self.store.put(MEMBER_PREFIX + self.member_id, json.dumps({
+            "host": host, "port": int(port), "pid": os.getpid(),
+            "registered_ts": time.time(),
+        }))
+        self._stop = StopToken()
+        self.beat()  # first beat lands BEFORE the router can see us
+        self._thread = threading.Thread(
+            target=self._beat_loop, name="dptpu-serve-fleet-beat",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @staticmethod
+    def _registry_load() -> dict:
+        scalars = obs.get_registry().scalars()
+        return {k: scalars[k] for k in _LOAD_KEYS if k in scalars}
+
+    def beat(self) -> None:
+        payload = {"ts": time.time()}
+        try:
+            payload["load"] = self._load_fn()
+        except Exception:
+            payload["load"] = {}  # a broken meter must not stop beats
+        self.store.put(BEAT_PREFIX + self.member_id, json.dumps(payload))
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.beat()
+            except OSError as e:
+                # the KV dir vanishing mid-run: keep trying (the router
+                # will drain us on staleness either way) but say so
+                print(f"=> fleet member {self.member_id}: heartbeat "
+                      f"write failed: {e}", file=sys.stderr, flush=True)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Clean shutdown: stop beating and write the ``draining``
+        tombstone so the router drains us on its NEXT poll instead of
+        waiting out the staleness deadline."""
+        self._stop.stop()
+        self._thread.join(timeout)
+        try:
+            self.store.put(BEAT_PREFIX + self.member_id, json.dumps({
+                "ts": time.time(), "draining": True,
+            }))
+        except OSError:
+            pass  # staleness catches what the tombstone cannot
+
+
+class FleetRouter:
+    """The routing tier over the registered members (no local engine).
+
+    Route table maintenance runs on one poll thread
+    (``dptpu-serve-fleet``); request forwarding runs on the callers'
+    threads, picking the healthy member with the fewest in-flight
+    forwards (joined-shortest-queue) and failing over on connection
+    death."""
+
+    def __init__(self, directory: str, *, deadline_s: float = 3.0,
+                 poll_s: float = 1.0, retries: int = 2,
+                 queue_depth: int = 64,
+                 priorities=(1.0, 0.85, 0.6), deadline_ms: float = 0.0,
+                 http_timeout_s: float = 60.0):
+        from dptpu.resilience.quorum import FileKVStore
+
+        self.store = FileKVStore(directory)
+        self.deadline_s = float(deadline_s)
+        self.retries = int(retries)
+        self.http_timeout_s = float(http_timeout_s)
+        self.admission = AdmissionController(
+            depth=queue_depth, priorities=priorities,
+            deadline_ms=deadline_ms, name="fleet",
+        )
+        self._lock = OrderedLock("serve.fleet")
+        self._members: Dict[str, dict] = {}  # guarded-by: _lock
+        self._inflight: Dict[str, int] = {}  # guarded-by: _lock
+        self._drains = 0  # guarded-by: _lock
+        self._stop = StopToken()
+        self.poll_s = float(poll_s)
+        self._poll_once()  # populate before the first request
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="dptpu-serve-fleet",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- membership -----------------------------------------------------
+
+    def _poll_loop(self):
+        while not self._stop.wait(self.poll_s):
+            try:
+                self._poll_once()
+            except Exception as e:
+                print(f"=> fleet router: membership poll failed: {e}",
+                      file=sys.stderr, flush=True)
+
+    def _poll_once(self):
+        regs = self.store.scan(MEMBER_PREFIX)
+        beats = self.store.scan(BEAT_PREFIX)
+        now = time.time()
+        alive: Dict[str, dict] = {}
+        for key, raw in regs.items():
+            member_id = key[len(MEMBER_PREFIX):]
+            try:
+                reg = json.loads(raw)
+                beat = json.loads(beats.get(BEAT_PREFIX + member_id, "{}"))
+            except ValueError:
+                continue  # torn JSON cannot happen (atomic put); skip
+            if beat.get("draining"):
+                continue  # clean-shutdown tombstone
+            age = now - float(beat.get("ts", 0.0))
+            if age > self.deadline_s:
+                continue  # the heartbeat verdict: stale = dead
+            alive[member_id] = {
+                "host": reg["host"], "port": int(reg["port"]),
+                "beat_age_s": age, "load": beat.get("load", {}),
+            }
+        with self._lock:
+            drained = set(self._members) - set(alive)
+            joined = set(alive) - set(self._members)
+            self._members = alive
+            for m in joined:
+                self._inflight.setdefault(m, 0)
+            self._drains += len(drained)
+        reg_counters = obs.get_registry()
+        reg_counters.gauge("Fleet/members").set(len(alive))
+        for m in drained:
+            reg_counters.counter("Fleet/drains").inc()
+            print(f"=> fleet DRAINED member {m} (stale heartbeat or "
+                  f"tombstone)", file=sys.stderr, flush=True)
+        for m in joined:
+            print(f"=> fleet joined member {m}", file=sys.stderr,
+                  flush=True)
+
+    def members(self) -> Dict[str, dict]:
+        with self._lock:
+            return {m: dict(v) for m, v in self._members.items()}
+
+    def _pick(self, exclude) -> Optional[Tuple[str, str, int]]:
+        """Healthy member with the fewest in-flight forwards, skipping
+        ``exclude``; increments its in-flight count (caller releases)."""
+        with self._lock:
+            candidates = [
+                (self._inflight.get(m, 0), m)
+                for m in self._members if m not in exclude
+            ]
+            if not candidates:
+                return None
+            _, member_id = min(candidates)
+            self._inflight[member_id] = \
+                self._inflight.get(member_id, 0) + 1
+            info = self._members[member_id]
+            return member_id, info["host"], info["port"]
+
+    def _release(self, member_id: str) -> None:
+        with self._lock:
+            if member_id in self._inflight:
+                self._inflight[member_id] -= 1
+
+    # -- request path ---------------------------------------------------
+
+    def forward(self, path: str, body: bytes,
+                headers: Optional[dict] = None) -> Tuple[int, bytes]:
+        """POST ``body`` to a healthy member; fail over on connection
+        death up to ``retries`` times. Returns ``(status, body)`` —
+        an HTTP-level error status from a member (4xx/5xx) is a real
+        ANSWER and is returned, not retried (only transport death is,
+        because only transport death is generation-ambiguous for the
+        member and idempotent for us)."""
+        tried = set()
+        last_err: Optional[Exception] = None
+        for _ in range(self.retries + 1):
+            picked = self._pick(tried)
+            if picked is None:
+                break
+            member_id, host, port = picked
+            try:
+                conn = http.client.HTTPConnection(
+                    host, port, timeout=self.http_timeout_s
+                )
+                try:
+                    conn.request("POST", path, body=body,
+                                 headers=headers or {})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    return resp.status, data
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException) as e:
+                last_err = e
+                tried.add(member_id)
+                obs.get_registry().counter("Fleet/failovers").inc()
+                print(f"=> fleet: member {member_id} connection died "
+                      f"({e.__class__.__name__}: {e}); failing over",
+                      file=sys.stderr, flush=True)
+            finally:
+                self._release(member_id)
+        if last_err is not None:
+            raise FleetUnavailable(
+                f"no healthy member answered after "
+                f"{len(tried)} failover(s): {last_err}"
+            )
+        raise FleetUnavailable("fleet has no healthy members")
+
+    def submit(self, path: str, body: bytes,
+               headers: Optional[dict] = None,
+               priority: str = "normal",
+               deadline_ms: Optional[float] = None) -> Tuple[int, bytes]:
+        """The admitted path: fleet-wide admission gate, then forward.
+        Raises :class:`~dptpu.serve.admission.AdmissionError` on shed."""
+        ticket = self.admission.try_admit(priority, deadline_ms)
+        t0 = time.perf_counter()
+        try:
+            status, data = self.forward(path, body, headers)
+        except BaseException:
+            self.admission.release(ticket)
+            raise
+        self.admission.release(
+            ticket,
+            service_ms=(time.perf_counter() - t0) * 1e3
+            if status == 200 else None,
+        )
+        return status, data
+
+    # -- health / lifecycle ---------------------------------------------
+
+    def readiness(self) -> Tuple[bool, List[str]]:
+        reasons: List[str] = []
+        with self._lock:
+            n = len(self._members)
+        if n == 0:
+            reasons.append("fleet: no healthy members")
+        if self.admission.shedding_hard():
+            reasons.append("fleet: shedding")
+        return not reasons, reasons
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "members": {m: dict(v) for m, v in self._members.items()},
+                "inflight": dict(self._inflight),
+                "drains": self._drains,
+                "admission": self.admission.stats(),
+            }
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.stop()
+        self._thread.join(timeout)
+
+
+def make_fleet_handler(fleet: FleetRouter):
+    """Stdlib handler for the fleet front — the member front's endpoint
+    surface (dptpu/serve/http.py) minus per-model detail: /predict
+    forwards, /healthz is liveness, /readyz is the fleet verdict,
+    /metrics is the front's registry + route table."""
+    from http.server import BaseHTTPRequestHandler
+
+    from dptpu.serve.http import DEADLINE_HEADER, PRIORITY_HEADER
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "dptpu-serve-fleet/1"
+
+        def _send(self, code: int, payload: dict, headers=()):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"ok": True, "fleet": True,
+                                 "members": sorted(fleet.members())})
+            elif self.path == "/readyz":
+                ready, reasons = fleet.readiness()
+                self._send(200 if ready else 503,
+                           {"ready": ready, "reasons": reasons})
+            elif self.path == "/metrics":
+                self._send(200, {
+                    "registry": obs.get_registry().scalars(),
+                    "fleet": fleet.stats(),
+                })
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if not (self.path == "/predict"
+                    or self.path.startswith("/predict/")):
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = -1
+            if not 0 < length <= 64 << 20:
+                self._send(400, {"error": "missing or oversized body"})
+                return
+            headers = {"Content-Type": "application/octet-stream"}
+            for h in (PRIORITY_HEADER, DEADLINE_HEADER):
+                if self.headers.get(h):
+                    headers[h] = self.headers[h]
+            try:
+                status, data = fleet.submit(
+                    self.path, self.rfile.read(length), headers,
+                    priority=self.headers.get(PRIORITY_HEADER, "normal"),
+                )
+            except AdmissionError as e:
+                hs = []
+                if e.retry_after_s:
+                    hs.append(("Retry-After", f"{e.retry_after_s:.3f}"))
+                self._send(e.status, {"error": str(e)}, hs)
+                return
+            except (BrokenPipeError, ConnectionResetError):
+                raise
+            except Exception as e:
+                self._send(500, {"error": str(e)})
+                return
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            try:
+                self.wfile.write(data)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    return Handler
+
+
+def serve_fleet_forever(fleet: FleetRouter, host: str = "127.0.0.1",
+                        port: int = 8000):
+    """Blocking fleet-front listener (the ``dptpu serve --fleet``
+    loop); Ctrl-C returns, leaving router lifecycle to the caller."""
+    from http.server import ThreadingHTTPServer
+
+    httpd = ThreadingHTTPServer((host, port), make_fleet_handler(fleet))
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return httpd

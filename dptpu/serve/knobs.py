@@ -42,6 +42,36 @@ Admission / robustness knobs (ISSUE 17):
   batch device time above ``factor x`` the baseline's triggers
   auto-rollback (default 5.0, > 1).
 
+Quantized-serving knobs (ISSUE 18, ``DPTPU_QUANT_*``):
+
+* ``DPTPU_QUANT_PRECISION`` — ``fp32`` / ``bf16`` / ``int8``: the
+  precision a quantized generation is deployed at (default fp32 = no
+  quantized rollout). Anything below fp32 REQUIRES a calibration
+  artifact and rides the canary gate — never a silent cutover;
+* ``DPTPU_QUANT_CALIB`` — path to the CRC-sealed calibration artifact
+  (``dptpu quantize`` output). Required when the precision knob is
+  below fp32; verified (CRC + arch + weights fingerprint) at load;
+* ``DPTPU_QUANT_DRIFT`` — operator override of the quantized rollout's
+  max|Δlogit| gate (default 0 = use the bound stated in the artifact);
+* ``DPTPU_QUANT_TOP1_MIN`` — operator override of the quantized
+  rollout's cumulative top-1 agreement floor (default 0 = use the
+  artifact's bound), in (0, 1].
+
+Fleet knobs (ISSUE 18, ``DPTPU_FLEET_*``):
+
+* ``DPTPU_FLEET_DIR`` — the shared quorum-KV directory fleet members
+  register in and the fleet router scans (required for ``--fleet`` /
+  member registration; empty = fleet disabled);
+* ``DPTPU_FLEET_HEARTBEAT_S`` — member heartbeat period (default 1.0,
+  > 0);
+* ``DPTPU_FLEET_DEADLINE_S`` — the staleness verdict: a member whose
+  last beat is older than this is auto-DRAINED from routing (default
+  3.0; must exceed the heartbeat period or every member flaps);
+* ``DPTPU_FLEET_RETRIES`` — per-request failover budget: a request
+  whose member connection dies is retried on another healthy member
+  this many times before the client sees an error (default 2, >= 0 —
+  the zero-failed-in-flight-requests lever during a drain).
+
 Stdlib-only: the CLI validates pre-jax (a typo'd knob must fail before
 any compile), and the conftest leak guard imports the serve package.
 """
@@ -61,9 +91,16 @@ DEFAULT_DEADLINE_MS = 0.0  # 0 = no server-imposed default deadline
 DEFAULT_CANARY_FRACTION = 0.1
 DEFAULT_CANARY_DRIFT = 50.0
 DEFAULT_CANARY_LAT_FACTOR = 5.0
+DEFAULT_PRECISION = "fp32"
+DEFAULT_QUANT_DRIFT = 0.0  # 0 = the calibration artifact's bound
+DEFAULT_QUANT_TOP1_MIN = 0.0  # 0 = the calibration artifact's bound
+DEFAULT_FLEET_HEARTBEAT_S = 1.0
+DEFAULT_FLEET_DEADLINE_S = 3.0
+DEFAULT_FLEET_RETRIES = 2
 
 PLACEMENTS = ("auto", "replicated", "tp")
 PRIORITY_NAMES = ("high", "normal", "low")
+PRECISIONS = ("fp32", "bf16", "int8")
 
 
 class ServeKnobs(NamedTuple):
@@ -77,6 +114,14 @@ class ServeKnobs(NamedTuple):
     canary_fraction: float
     canary_drift: float
     canary_lat_factor: float
+    precision: str = DEFAULT_PRECISION
+    calib: str = ""
+    quant_drift: float = DEFAULT_QUANT_DRIFT
+    quant_top1_min: float = DEFAULT_QUANT_TOP1_MIN
+    fleet_dir: str = ""
+    fleet_heartbeat_s: float = DEFAULT_FLEET_HEARTBEAT_S
+    fleet_deadline_s: float = DEFAULT_FLEET_DEADLINE_S
+    fleet_retries: int = DEFAULT_FLEET_RETRIES
 
 
 def parse_buckets(raw, source: str = "DPTPU_SERVE_BUCKETS"
@@ -165,6 +210,14 @@ def serve_knobs(buckets: Optional[Sequence[int]] = None,
                 canary_fraction: Optional[float] = None,
                 canary_drift: Optional[float] = None,
                 canary_lat_factor: Optional[float] = None,
+                precision: Optional[str] = None,
+                calib: Optional[str] = None,
+                quant_drift: Optional[float] = None,
+                quant_top1_min: Optional[float] = None,
+                fleet_dir: Optional[str] = None,
+                fleet_heartbeat_s: Optional[float] = None,
+                fleet_deadline_s: Optional[float] = None,
+                fleet_retries: Optional[int] = None,
                 environ=None) -> ServeKnobs:
     """Resolve + validate the serve knobs. Arguments are the CLI/config
     values (None = not given); the env twins override them when set; the
@@ -288,6 +341,97 @@ def serve_knobs(buckets: Optional[Sequence[int]] = None,
             f"would roll back on measurement noise)"
         )
 
+    prec = env_choice("DPTPU_QUANT_PRECISION", PRECISIONS, None,
+                      environ=env)
+    if prec is None:
+        prec = precision if precision is not None else DEFAULT_PRECISION
+    if prec not in PRECISIONS:
+        raise ValueError(
+            f"--precision={prec!r} must be one of "
+            + "/".join(repr(p) for p in PRECISIONS)
+        )
+
+    calib_path = env_str("DPTPU_QUANT_CALIB", "", environ=env)
+    if not calib_path:
+        calib_path = calib if calib is not None else ""
+    if prec != "fp32" and not calib_path:
+        raise ValueError(
+            f"precision {prec!r} needs a calibration artifact: set "
+            f"DPTPU_QUANT_CALIB/--calib to a `dptpu quantize` output "
+            f"(sub-fp32 serving without a provenance-stamped artifact "
+            f"is the silent-drift path this refuses)"
+        )
+
+    qdrift = env_float("DPTPU_QUANT_DRIFT", None, environ=env)
+    source = "DPTPU_QUANT_DRIFT"
+    if qdrift is None:
+        qdrift, source = quant_drift, "--quant-drift"
+    if qdrift is None:
+        qdrift = DEFAULT_QUANT_DRIFT
+    if qdrift < 0:
+        raise ValueError(
+            f"{source}={qdrift} must be >= 0 (0 = enforce the "
+            f"max|Δlogit| bound stated in the calibration artifact; "
+            f"> 0 overrides it)"
+        )
+
+    top1 = env_float("DPTPU_QUANT_TOP1_MIN", None, environ=env)
+    source = "DPTPU_QUANT_TOP1_MIN"
+    if top1 is None:
+        top1, source = quant_top1_min, "--quant-top1-min"
+    if top1 is None:
+        top1 = DEFAULT_QUANT_TOP1_MIN
+    if not 0.0 <= top1 <= 1.0:
+        raise ValueError(
+            f"{source}={top1} must be a fraction in [0, 1] (0 = enforce "
+            f"the top-1 agreement floor stated in the calibration "
+            f"artifact; > 0 overrides it)"
+        )
+
+    fdir = env_str("DPTPU_FLEET_DIR", "", environ=env)
+    if not fdir:
+        fdir = fleet_dir if fleet_dir is not None else ""
+
+    beat = env_float("DPTPU_FLEET_HEARTBEAT_S", None, environ=env)
+    source = "DPTPU_FLEET_HEARTBEAT_S"
+    if beat is None:
+        beat, source = fleet_heartbeat_s, "--fleet-heartbeat-s"
+    if beat is None:
+        beat = DEFAULT_FLEET_HEARTBEAT_S
+    if beat <= 0:
+        raise ValueError(
+            f"{source}={beat} must be > 0 seconds (the fleet member "
+            f"heartbeat period)"
+        )
+
+    fdl = env_float("DPTPU_FLEET_DEADLINE_S", None, environ=env)
+    source = "DPTPU_FLEET_DEADLINE_S"
+    if fdl is None:
+        fdl, source = fleet_deadline_s, "--fleet-deadline-s"
+    if fdl is None:
+        fdl = DEFAULT_FLEET_DEADLINE_S
+    if fdl <= beat:
+        raise ValueError(
+            f"{source}={fdl} must exceed the heartbeat period ({beat}s) "
+            f"— a deadline at or under one beat drains every healthy "
+            f"member on scheduler jitter"
+        )
+
+    retries = env_int("DPTPU_FLEET_RETRIES", None, environ=env)
+    source = "DPTPU_FLEET_RETRIES"
+    if retries is None:
+        retries, source = fleet_retries, "--fleet-retries"
+    if retries is None:
+        retries = DEFAULT_FLEET_RETRIES
+    if retries < 0:
+        raise ValueError(
+            f"{source}={retries} must be >= 0 failover retries (0 "
+            f"disables failover: a member dying mid-request surfaces "
+            f"to the client)"
+        )
+
     return ServeKnobs(out_buckets, float(delay), place, int(n_slots),
                       int(depth), out_prios, float(dl), float(frac),
-                      float(drift), float(lat))
+                      float(drift), float(lat), prec, str(calib_path),
+                      float(qdrift), float(top1), str(fdir),
+                      float(beat), float(fdl), int(retries))

@@ -26,11 +26,112 @@ staging-buffer traffic and one fewer host-side float pass per request.
 from __future__ import annotations
 
 import io
+import sys
 from typing import Optional
 
 import numpy as np
 
 from dptpu.data.transforms import ValTransform
+
+# fused native serve-ingest (dptpu_serve_ingest in image_ops.cpp): JPEG
+# bytes -> val pixels straight into the staging row, one native call, no
+# PIL round trip. It is only ever used after PROVING bit-identity against
+# the PIL path on this host's libjpeg (tri-state: None = not yet probed).
+_NATIVE_INGEST_OK: Optional[bool] = None
+
+_JPEG_MAGIC = b"\xff\xd8\xff"
+
+
+def _pil_val_pixels(data: bytes, size: int, resize: int) -> np.ndarray:
+    """The reference PIL path, non-recursively (what the probe compares
+    the native kernel against)."""
+    from PIL import Image
+
+    tf = ValTransform(size, resize)
+    with Image.open(io.BytesIO(data)) as img:
+        return tf(img.convert("RGB"))
+
+
+def _probe_native_ingest() -> bool:
+    """Prove ``dptpu_serve_ingest`` bit-identical to the PIL path on THIS
+    host before it may serve a single request. The probe JPEGs cover the
+    geometries that exercise every branch of the resample (odd dims,
+    portrait/landscape, grayscale->RGB replication, box-enlarge,
+    progressive scan); any mismatching byte disables the kernel for the
+    process, LOUDLY — served pixels silently diverging from the pixels
+    accuracy was measured on is the one failure this path must not have.
+    """
+    from dptpu.native.build import load_library
+
+    lib = load_library()
+    if lib is None or not hasattr(lib, "dptpu_serve_ingest"):
+        return False
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    cases = []
+    for (w, h, mode, kw) in [
+        (277, 179, "RGB", {"quality": 85}),
+        (160, 240, "RGB", {"quality": 92}),
+        (200, 200, "L", {"quality": 85}),
+        (96, 80, "RGB", {"quality": 90}),   # resize=256 ENLARGES this one
+        (230, 310, "RGB", {"quality": 85, "progressive": True}),
+    ]:
+        shape = (h, w, 3) if mode == "RGB" else (h, w)
+        buf = io.BytesIO()
+        Image.fromarray(rng.randint(0, 256, shape, np.uint8), mode).save(
+            buf, "JPEG", **kw
+        )
+        cases.append(buf.getvalue())
+    for size, resize in ((224, 256), (64, 73)):
+        for data in cases:
+            native = np.empty((size, size, 3), np.uint8)
+            rc = lib.dptpu_serve_ingest(data, len(data), size, resize,
+                                        native.ctypes.data)
+            if rc != 0 or not np.array_equal(
+                native, _pil_val_pixels(data, size, resize)
+            ):
+                print(
+                    "=> dptpu serve-ingest native kernel FAILED the "
+                    f"bit-identity probe (rc={rc}, size={size}) — this "
+                    "host's libjpeg does not reproduce PIL's pixels; "
+                    "serving stays on the PIL path (slower, identical "
+                    "output)", file=sys.stderr, flush=True,
+                )
+                return False
+    return True
+
+
+def _native_ingest(data: bytes, size: int, resize: int,
+                   out: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """The fused path, or None when the caller must use PIL (probe
+    failed, non-JPEG bytes, or a per-image bail like CMYK color)."""
+    global _NATIVE_INGEST_OK
+    if not data.startswith(_JPEG_MAGIC):
+        return None
+    if _NATIVE_INGEST_OK is None:
+        _NATIVE_INGEST_OK = _probe_native_ingest()
+    if not _NATIVE_INGEST_OK:
+        return None
+    from dptpu.native.build import load_library
+
+    lib = load_library()
+    if out is not None and (out.shape != (size, size, 3)
+                            or out.dtype != np.uint8):
+        raise ValueError(
+            f"preprocess out buffer is {out.dtype}{out.shape}, "
+            f"expected uint8{(size, size, 3)}"
+        )
+    dst = out if (out is not None and out.flags.c_contiguous) else \
+        np.empty((size, size, 3), np.uint8)
+    rc = lib.dptpu_serve_ingest(data, len(data), size, resize,
+                                dst.ctypes.data)
+    if rc != 0:
+        return None  # corrupt/CMYK/etc: PIL decides (and 400s cleanly)
+    if out is not None and dst is not out:
+        np.copyto(out, dst)
+        return out
+    return dst
 
 
 def val_resize_for(size: int) -> int:
@@ -61,11 +162,23 @@ def preprocess_bytes(data: bytes, size: int = 224,
 
     ``_transform`` lets a hot caller reuse one ``ValTransform`` (it is
     stateless; the default constructs per call for the one-shot case).
+
+    JPEG requests take the fused native serve-ingest kernel
+    (``dptpu_serve_ingest``) when — and only when — it has PROVED
+    bit-identity with the PIL path on this host (probe at first use,
+    loud stderr fallback): one native call decodes and box-resamples
+    straight into ``out``, so the identical pixels arrive without the
+    PIL round trip or any intermediate fp32 buffer. Every other
+    container, and every native bail (CMYK, corrupt bytes), lands on
+    the PIL path below — same pixels either way, that is the contract.
     """
     from PIL import Image, UnidentifiedImageError
 
     if resize is None:
         resize = val_resize_for(size)
+    fast = _native_ingest(data, size, resize, out)
+    if fast is not None:
+        return fast
     tf = _transform if _transform is not None else ValTransform(size, resize)
     try:
         with Image.open(io.BytesIO(data)) as img:

@@ -1,0 +1,221 @@
+"""Serve-side quantization: the calibration artifact and its loader.
+
+``dptpu quantize`` runs OFFLINE: it builds the fp32 model, computes
+per-channel absmax scales (dptpu/ops/quant.py), replays a shard sample
+through both the fp32 and the quantized forward, and commits the
+result as a **calibration artifact** — the provenance record a
+quantized deployment must present before it is allowed to serve:
+
+* CRC-sealed with the checkpoint footer discipline
+  (``dptpu.train.checkpoint.seal_payload``) — bit rot and truncated
+  writes fail the load, never parse;
+* stamped with the arch AND a content fingerprint of the exact weights
+  it was calibrated against — quantizing *different* weights with
+  stale scales is the silent-drift path, so the loader refuses it by
+  name;
+* carrying the drift gate's bounds (min top-1 agreement, max|Δlogit|)
+  **measured on the calibration sample** — the canary controller
+  enforces the same bounds online, so the artifact states exactly what
+  "no drift" means for this deployment.
+
+Every load failure names the recalibration command — the operator
+never has to reverse-engineer what went stale.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dptpu.ops.quant import cast_tree, quantize_tree, scales_tree
+from dptpu.serve.knobs import PRECISIONS
+
+# Artifact format version: bump on any change to the scales scheme or
+# the meta layout (the loader refuses newer schemes by name).
+CALIB_SCHEME = "absmax-int8-perchannel-v1"
+
+# Conservative defaults when the operator does not override: bounds are
+# stamped from the MEASURED calibration-sample stats with this margin
+# (drift grows ~sqrt(depth) off-sample; 2x headroom keeps the gate
+# honest without tripping on sampling noise).
+DRIFT_MARGIN = 2.0
+
+
+class CalibrationError(ValueError):
+    """Calibration artifact missing/corrupt/mismatched — message always
+    names the ``dptpu quantize`` recalibration command."""
+
+
+def _recalib_cmd(arch: str, path: str) -> str:
+    return f"dptpu quantize --arch {arch} --out {path}"
+
+
+def weights_fingerprint(params) -> str:
+    """Content fingerprint of a param tree: crc32 over (path, shape,
+    dtype, raw bytes) of every leaf in canonical flatten order. Ties an
+    artifact to the EXACT weights it was calibrated from — a resumed
+    checkpoint, a different seed, or a new pretrained drop all change
+    the fingerprint and force recalibration."""
+    import jax
+
+    crc = 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        a = np.asarray(leaf)
+        header = f"{jax.tree_util.keystr(path)}|{a.shape}|{a.dtype}"
+        crc = zlib.crc32(header.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def measure_drift(base_logits, q_logits) -> Tuple[float, float]:
+    """``(top1_agreement, max_abs_dlogit)`` between two logit batches —
+    the SERVEBENCH parity-style pair the quantized gate is built on."""
+    b = np.asarray(base_logits, np.float32)
+    q = np.asarray(q_logits, np.float32)
+    if b.shape != q.shape:
+        raise ValueError(f"logit shape mismatch {b.shape} vs {q.shape}")
+    agree = float(np.mean(b.argmax(-1) == q.argmax(-1)))
+    drift = float(np.max(np.abs(b - q))) if b.size else 0.0
+    return agree, drift
+
+
+def quantize_variables(variables: dict, precision: str,
+                       scales: Optional[dict] = None) -> dict:
+    """A serve variables dict (``{"params", "batch_stats"}``) at the
+    requested precision. ``batch_stats`` always stays fp32 (BN moving
+    stats are normalization state, same rule as norm params)."""
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision {precision!r} not in {PRECISIONS}"
+        )
+    bs = variables.get("batch_stats", {})
+    if precision == "fp32":
+        return {"params": variables["params"], "batch_stats": bs}
+    if precision == "bf16":
+        import jax.numpy as jnp
+
+        return {"params": cast_tree(variables["params"], jnp.bfloat16),
+                "batch_stats": bs}
+    return {"params": quantize_tree(variables["params"], scales),
+            "batch_stats": bs}
+
+
+def save_calibration(path: str, *, arch: str, params, stats: dict,
+                     bounds: dict, num_classes: int,
+                     image_size: int, sample_n: int,
+                     extra_meta: Optional[dict] = None) -> dict:
+    """Seal + atomically write the calibration artifact. Returns the
+    restored-form payload (what :func:`load_calibration` will answer)."""
+    from flax import serialization
+
+    from dptpu.train.checkpoint import seal_payload
+    from dptpu.utils.provenance import host_provenance
+
+    payload = {
+        "meta": {
+            "scheme": CALIB_SCHEME,
+            "arch": arch,
+            "weights_fingerprint": weights_fingerprint(params),
+            "num_classes": int(num_classes),
+            "image_size": int(image_size),
+            "sample_n": int(sample_n),
+            "stats": {k: float(v) for k, v in stats.items()},
+            "bounds": {k: float(v) for k, v in bounds.items()},
+            "host": host_provenance(),
+        },
+        "scales": scales_tree(params),
+    }
+    raw = seal_payload(serialization.msgpack_serialize(payload))
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".calib-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return payload
+
+
+def load_calibration(path: str, *, arch: Optional[str] = None,
+                     params=None) -> dict:
+    """Load + verify a calibration artifact; every failure is a
+    :class:`CalibrationError` naming the recalibration command.
+
+    Checks, in order: file present and non-empty → CRC footer present
+    AND valid (an unfooted file is not a calibration artifact) → scheme
+    known → arch matches (when given) → weights fingerprint matches the
+    live params (when given) — the arch/generation match the ISSUE
+    locks."""
+    from flax import serialization
+
+    from dptpu.train.checkpoint import CorruptCheckpointError, split_payload
+
+    cmd = _recalib_cmd(arch or "<arch>", path)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise CalibrationError(
+            f"calibration artifact {path}: {e.strerror or e} — "
+            f"produce one with: {cmd}"
+        ) from e
+    if not raw:
+        raise CalibrationError(
+            f"calibration artifact {path} is empty (crashed write?) — "
+            f"recalibrate with: {cmd}"
+        )
+    try:
+        payload_bytes, verified = split_payload(raw, path)
+    except CorruptCheckpointError as e:
+        raise CalibrationError(
+            f"{e} — recalibrate with: {cmd}"
+        ) from e
+    if not verified:
+        raise CalibrationError(
+            f"calibration artifact {path} has no CRC footer — not a "
+            f"dptpu calibration artifact (or truncated past the "
+            f"footer); recalibrate with: {cmd}"
+        )
+    try:
+        payload = serialization.msgpack_restore(payload_bytes)
+    except Exception as e:
+        raise CalibrationError(
+            f"calibration artifact {path} failed to parse after a "
+            f"clean CRC ({e}) — recalibrate with: {cmd}"
+        ) from e
+    meta = payload.get("meta", {})
+    if meta.get("scheme") != CALIB_SCHEME:
+        raise CalibrationError(
+            f"calibration artifact {path}: scheme "
+            f"{meta.get('scheme')!r} != {CALIB_SCHEME!r} (artifact from "
+            f"a different dptpu version) — recalibrate with: {cmd}"
+        )
+    if arch is not None and meta.get("arch") != arch:
+        raise CalibrationError(
+            f"calibration artifact {path} was calibrated for arch "
+            f"{meta.get('arch')!r}, not {arch!r} — recalibrate with: "
+            f"{_recalib_cmd(arch, path)}"
+        )
+    if params is not None:
+        live = weights_fingerprint(params)
+        want = meta.get("weights_fingerprint")
+        if live != want:
+            raise CalibrationError(
+                f"calibration artifact {path} was calibrated against "
+                f"weights {want} but the engine is serving weights "
+                f"{live} (new checkpoint / different generation) — "
+                f"stale scales drift silently, so this refuses to "
+                f"load; recalibrate with: {cmd}"
+            )
+    return payload

@@ -63,7 +63,8 @@ def build_served_model(name: str, arch: str, knobs, *,
     canary = CanaryController(
         engine, fraction=knobs.canary_fraction,
         drift_limit=knobs.canary_drift,
-        lat_factor=knobs.canary_lat_factor, fault_plan=fault_plan,
+        lat_factor=knobs.canary_lat_factor,
+        min_top1_agreement=knobs.quant_top1_min, fault_plan=fault_plan,
     )
     batcher = DynamicBatcher(
         engine, max_delay_ms=knobs.max_delay_ms, slots=knobs.slots,
@@ -150,6 +151,18 @@ class ModelRouter:
         """Stage a canary generation on one model (see
         :class:`~dptpu.serve.canary.CanaryController`)."""
         return self.model(model).canary.start(variables)
+
+    def start_quantized(self, knobs, model: Optional[str] = None) -> int:
+        """Deploy a quantized generation on one model per the validated
+        :class:`~dptpu.serve.knobs.ServeKnobs`: the engine verifies the
+        calibration artifact, the canary gate enforces the artifact's
+        bounds (operator knobs > 0 override), and a drifting rollout
+        auto-rolls-back — the ONLY path to sub-fp32 serving."""
+        return self.model(model).canary.start_quantized(
+            knobs.calib, precision=knobs.precision,
+            drift_limit=knobs.quant_drift or None,
+            top1_min=knobs.quant_top1_min or None,
+        )
 
     def stats(self) -> dict:
         return {

@@ -67,6 +67,10 @@ LOCK_RANKS = {
     # canary controller sits between admission and the engine because
     # pick/rollback/promote pin generations while holding its lock.
     "serve.batcher": 10,
+    # the fleet router's route table: acquired only from the fleet
+    # front's poll/pick/release paths, never while holding (or under)
+    # any member-side serve lock — forwarding happens entirely off-lock.
+    "serve.fleet": 12,
     "serve.admission": 15,
     "serve.canary": 18,
     "serve.engine": 20,

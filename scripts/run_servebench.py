@@ -35,6 +35,13 @@ Gates (exit non-zero on failure unless ``--no-gate``):
   bucket-1 calls, max|dlogit| must be exactly 0) — the engine's
   batch-invariant-numerics contract, re-proven on the bench engine.
 
+Plus the ISSUE 18 arms, both gated: the **quantized** arm rolls an
+int8 calibration artifact out through the canary's artifact-armed
+drift/top-1 gate and reports residency + throughput vs fp32, and the
+**fleet** arm hard-kills one of two member hosts mid-load and requires
+zero failed requests while the router fails over and the staleness
+verdict auto-drains the corpse (drain curve on record).
+
 Also measured: ``preprocess_bytes`` cost (the bytes->pixels ingest,
 amortized over repeats) so the curves' decode-free request path
 (``submit_array``) is an EXPLICIT choice with the excluded cost on
@@ -498,6 +505,358 @@ def serve_faults_arm(engine, knobs, pool):
     return results
 
 
+# -- quantized serving + fleet arms (ISSUE 18) ---------------------------
+
+
+def quantized_serving_arm(engine, knobs, pool, n_requests, workdir,
+                          baseline_qps, concurrency):
+    """Post-training int8 through the REAL rollout path: calibrate from
+    the engine's live fp32 weights (same scales/seal/bounds policy as
+    ``dptpu quantize``), roll the artifact out via the canary's
+    artifact-armed gate — promotion must be EARNED by the shadow evals,
+    not assumed — then measure the promoted generation's closed-loop
+    throughput and weight residency against fp32.
+
+    The acceptance lever is throughput >= 1.3x OR resident-bytes cut
+    >= 40%. On a CPU host the residency cut is the honest lever: this
+    backend has no int8/bf16 gemm kernels (every sub-fp32 dot is
+    convert+f32-dot after float normalization), so the compute win is
+    a TPU claim — gated STATICALLY by the serve-quant HLO budget row
+    (requested dot dtypes + s8 parameter count), not by this arm."""
+    from dptpu.ops.quant import tree_nbytes
+    from dptpu.serve import DynamicBatcher
+    from dptpu.serve.canary import CanaryController
+    from dptpu.serve.quant import (measure_drift, quantize_variables,
+                                   save_calibration)
+
+    base_gen = engine.current_generation
+    sample = np.stack(pool[:8])
+    bucket = engine.bucket_for(len(sample))
+    nexec = engine.exec_batch(bucket)
+    padded = np.concatenate(
+        [sample, np.broadcast_to(sample[0],
+                                 (nexec - len(sample),) + sample.shape[1:])]
+    ) if nexec > len(sample) else sample
+    base_logits = engine.run_bucket(bucket, padded, len(sample))
+
+    # calibration: quantize the host fp32 weights, measure drift on the
+    # sample through a throwaway staged generation, derive the gate
+    # bounds with the CLI's margin policy, seal the artifact
+    qvars = quantize_variables(engine._host_variables, "int8")
+    tmp_gen = engine.stage_weights(qvars, precision="int8")
+    q_logits = engine.run_bucket(bucket, padded, len(sample), gen=tmp_gen)
+    engine.discard_staged(tmp_gen)
+    agree, drift = measure_drift(base_logits, q_logits)
+    bounds = {"max_abs_dlogit": max(drift * 2.0, 1e-3),
+              "min_top1_agreement": max(0.5, agree - 0.05)}
+    calib = os.path.join(workdir, "servebench-calib.msgpack")
+    save_calibration(
+        calib, arch=engine.arch, params=engine._host_variables["params"],
+        stats={"top1_agreement": agree, "max_abs_dlogit": drift},
+        bounds=bounds, num_classes=engine.num_classes,
+        image_size=engine.image_size, sample_n=len(sample),
+    )
+
+    fp32_bytes = engine.resident_bytes()[base_gen]
+    bf16_bytes = tree_nbytes(
+        quantize_variables(engine._host_variables, "bf16"))
+
+    # the rollout: canary-gated promotion under the artifact's bounds.
+    # min_batches is set in ROWS so the co-resident interference point
+    # below runs entirely inside the canary phase (fp32 and int8 both
+    # resident and both serving), then the extra submissions afterwards
+    # earn the promotion through the same shadow evals.
+    canary = CanaryController(engine, fraction=0.5,
+                              min_batches=max(n_requests, 20))
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=knobs.slots,
+                       canary=canary)
+    try:
+        gen = canary.start_quantized(calib, precision="int8")
+        int8_bytes = engine.resident_bytes()[gen]
+
+        # co-resident interference: closed-loop through the canary
+        # batcher while HALF the batches pin int8 and every int8 batch
+        # is shadow-replayed at fp32 — the quantized+fp32-coresident
+        # load the multi-model router would see mid-rollout
+        done, errs = [], []
+        lock = threading.Lock()
+        remaining = [n_requests]
+
+        def co_client(tid):
+            i = tid
+            while True:
+                with lock:
+                    if remaining[0] <= 0:
+                        return
+                    remaining[0] -= 1
+                try:
+                    f = b.submit_array(pool[i % len(pool)])
+                    f.result(timeout=300)
+                    with lock:
+                        done.append(f)
+                except Exception as e:  # pragma: no cover
+                    with lock:
+                        errs.append(e)
+                    return
+                i += 4
+
+        t0 = time.perf_counter()
+        co_threads = [threading.Thread(target=co_client, args=(t,))
+                      for t in range(4)]
+        for t in co_threads:
+            t.start()
+        for t in co_threads:
+            t.join()
+        co_wall = time.perf_counter() - t0
+        if errs:
+            raise RuntimeError(f"co-resident client failed: {errs[0]}")
+        by_gen = {}
+        for f in done:
+            key = "int8" if f.generation == gen else "fp32"
+            by_gen[key] = by_gen.get(key, 0) + 1
+        coresident = {
+            "requests": len(done),
+            "qps": round(len(done) / co_wall, 2),
+            "by_generation": by_gen,
+            "state_during": canary.status()["state"],
+        }
+
+        shadow = len(done)
+        for i in range(8 * max(n_requests, 20)):
+            b.submit_array(pool[i % len(pool)]).result(timeout=300)
+            shadow += 1
+            canary.drain_evals(timeout=60)
+            if canary.status()["state"] != "canary":
+                break
+        st = canary.status()
+    finally:
+        b.close()
+        canary.close()
+    promoted = st["state"] == "promoted" \
+        and engine.generation_precision() == "int8"
+
+    quant_point = None
+    speedup = 0.0
+    if promoted:
+        # default traffic now serves int8: same closed-loop point as
+        # the fp32 saturation concurrency, same request pool
+        quant_point = closed_loop_point(engine, knobs, pool, concurrency,
+                                        n_requests)
+        speedup = quant_point["achieved_qps"] / max(baseline_qps, 1e-9)
+        # restore fp32 so later arms measure the base configuration
+        back = engine.stage_weights(engine._host_variables)
+        engine.promote(back)
+
+    residency_cut = 1.0 - int8_bytes / max(fp32_bytes, 1)
+    return {
+        "calibration": {
+            "sample_n": len(sample),
+            "top1_agreement": round(agree, 4),
+            "max_abs_dlogit": round(drift, 5),
+            "bounds": {k: round(v, 5) for k, v in bounds.items()},
+        },
+        "rollout": {
+            "state": st["state"],
+            "shadow_requests": shadow,
+            "max_drift": round(st["max_drift"], 5),
+            "drift_limit": st["drift_limit"],
+            "top1_agreement": st["top1_agreement"],
+            "top1_floor": st["top1_floor"],
+            "rollbacks": st["rollbacks"],
+        },
+        "coresident": coresident,
+        "resident_bytes": {"fp32": fp32_bytes, "bf16": bf16_bytes,
+                           "int8": int8_bytes},
+        "residency_cut": round(residency_cut, 4),
+        "int8_closed_loop": quant_point,
+        "fp32_qps": baseline_qps,
+        "int8_qps": quant_point["achieved_qps"] if quant_point else None,
+        "speedup": round(speedup, 3),
+        "lever": ("residency" if residency_cut >= 0.40 else
+                  "throughput" if speedup >= 1.3 else "none"),
+        "caveat": ("CPU host dequantizes to bf16-requested dots that the "
+                   "backend rewrites as f32 — the compute speedup is a "
+                   "TPU claim; the HLO budget row serve_quant gates the "
+                   "requested dtypes statically"),
+        "ok": bool(promoted
+                   and drift <= bounds["max_abs_dlogit"]
+                   and agree >= bounds["min_top1_agreement"]
+                   and (speedup >= 1.3 or residency_cut >= 0.40)),
+    }
+
+
+def fleet_arm(engine, knobs, pool, n_requests, workdir):
+    """The multi-host serve fleet, in-process: two member HTTP servers
+    (threads sharing this bench's engine — the routing tier is what is
+    under measurement, not a second model replica), a FleetRouter
+    fronted by fleet-wide admission, closed-loop load through
+    ``submit``, then the acceptance scenario: HARD-kill one member
+    mid-load (listener closed, heartbeat stopped, NO tombstone — crash
+    semantics) and require ZERO failed requests while the router fails
+    over in-flight forwards and the staleness verdict auto-drains the
+    dead member. The drain curve (healthy-member count over time) is
+    on record."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from dptpu import obs
+    from dptpu.serve.fleet import FleetMember, FleetRouter
+
+    fleet_dir = os.path.join(workdir, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    shape = pool[0].shape
+
+    def _member_server(member_id):
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                x = np.frombuffer(self.rfile.read(n),
+                                  np.uint8).reshape(shape)
+                logits = engine.infer(x[None])
+                payload = json.dumps({
+                    "member": member_id,
+                    "argmax": int(np.argmax(logits[0])),
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    beat_s, stale_s = 0.15, 0.6
+    servers = {m: _member_server(m) for m in ("host-a", "host-b")}
+    members = {
+        m: FleetMember(fleet_dir, host="127.0.0.1",
+                       port=srv.server_address[1], member_id=m,
+                       heartbeat_s=beat_s)
+        for m, srv in servers.items()
+    }
+    router = FleetRouter(fleet_dir, deadline_s=stale_s, poll_s=0.1,
+                         retries=2)
+    scalars0 = obs.get_registry().scalars()
+    failovers0 = scalars0.get("Fleet/failovers", 0)
+
+    outcomes, errs = [], []
+    lock = threading.Lock()
+    kill_at = n_requests // 3
+    killed = [None]  # [kill wall-clock ts]
+
+    def client(tid, total, t0):
+        i = tid
+        while True:
+            with lock:
+                if total[0] <= 0:
+                    return
+                total[0] -= 1
+                seq = n_requests - total[0]
+            if seq == kill_at and killed[0] is None:
+                # crash host-a: listener gone (transport death for every
+                # in-flight and future forward), heartbeat silenced
+                # without a tombstone — only staleness can drain it
+                servers["host-a"].shutdown()
+                servers["host-a"].server_close()
+                members["host-a"]._stop.stop()
+                killed[0] = time.perf_counter()
+            body = pool[i % len(pool)].tobytes()
+            try:
+                status, data = router.submit("/predict/bench", body)
+                with lock:
+                    outcomes.append(
+                        (time.perf_counter() - t0, status,
+                         json.loads(data)["member"]))
+            except Exception as e:
+                with lock:
+                    errs.append(repr(e))
+                return
+            i += 4
+
+    # warm both member endpoints directly (JSQ with zero load would
+    # send consecutive router warms to the same lexicographic-min host)
+    import http.client
+    for srv in servers.values():
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=60)
+        conn.request("POST", "/predict/bench", body=pool[0].tobytes())
+        assert conn.getresponse().read()
+        conn.close()
+
+    curve = []
+    stop_sampler = threading.Event()
+
+    def sampler(t0):
+        while not stop_sampler.wait(0.05):
+            curve.append({"t_s": round(time.perf_counter() - t0, 3),
+                          "members": len(router.members())})
+
+    total = [n_requests]
+    t0 = time.perf_counter()
+    threading.Thread(target=sampler, args=(t0,), daemon=True).start()
+    threads = [threading.Thread(target=client, args=(t, total, t0))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stop_sampler.set()
+
+    # the staleness verdict needs one more beat-deadline to land if the
+    # load finished fast; wait it out, then read the route table
+    deadline = time.time() + stale_s + 0.5
+    while "host-a" in router.members() and time.time() < deadline:
+        time.sleep(0.05)
+    alive = sorted(router.members())
+    drained_after_s = None
+    if killed[0] is not None:
+        drain_samples = [p["t_s"] for p in curve if p["members"] < 2
+                         and p["t_s"] > killed[0] - t0]
+        if drain_samples:
+            drained_after_s = round(
+                drain_samples[0] - (killed[0] - t0), 3)
+    failovers = obs.get_registry().scalars().get("Fleet/failovers", 0) \
+        - failovers0
+    by_member = {}
+    for _, _, m in outcomes:
+        by_member[m] = by_member.get(m, 0) + 1
+    stats = router.stats()
+    ready, _ = router.readiness()
+
+    router.close()
+    members["host-b"].close()
+    servers["host-b"].shutdown()
+    servers["host-b"].server_close()
+
+    failed = len(errs) + sum(1 for _, s, _ in outcomes if s != 200)
+    return {
+        "members": 2,
+        "requests": len(outcomes),
+        "fleet_qps": round(len(outcomes) / wall, 2),
+        "by_member": by_member,
+        "killed_member": "host-a",
+        "killed_at_request": kill_at,
+        "failed_requests": failed,
+        "client_errors": errs[:3],
+        "failovers": failovers,
+        "drains": stats["drains"],
+        "drained_after_s": drained_after_s,
+        "drain_curve": curve,
+        "survivors": alive,
+        "ready_after_drain": ready,
+        "admission": stats["admission"],
+        "ok": bool(failed == 0
+                   and len(outcomes) == n_requests
+                   and alive == ["host-b"]
+                   and failovers >= 1
+                   and stats["drains"] >= 1
+                   and ready),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -622,14 +981,46 @@ def main():
         "faults_ok": flt["ok"],
     })
 
+    # quantized serving + fleet arms (ISSUE 18): the int8 rollout
+    # through the canary's artifact-armed gate, then the routing tier's
+    # dead-host acceptance scenario — both in a scratch workdir so the
+    # calibration artifact and fleet KV dir never land in the repo
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="servebench-") as workdir:
+        quant = quantized_serving_arm(engine, knobs, pool, n_req,
+                                      workdir, saturation_qps, sat_at)
+        print(f"quantized: rollout {quant['rollout']['state']} after "
+              f"{quant['rollout']['shadow_requests']} shadow requests, "
+              f"drift {quant['calibration']['max_abs_dlogit']} "
+              f"(bound {quant['calibration']['bounds']['max_abs_dlogit']})"
+              f", residency cut {quant['residency_cut']:.1%}, "
+              f"int8 {quant['int8_qps']} qps vs fp32 "
+              f"{quant['fp32_qps']} qps, coresident "
+              f"{quant['coresident']['qps']} qps "
+              f"{quant['coresident']['by_generation']} "
+              f"(lever: {quant['lever']})")
+        fleet = fleet_arm(engine, knobs, pool, max(n_req, 30), workdir)
+        print(f"fleet: {fleet['requests']} requests over "
+              f"{fleet['members']} members at {fleet['fleet_qps']} qps, "
+              f"killed {fleet['killed_member']} at request "
+              f"{fleet['killed_at_request']} -> {fleet['failed_requests']}"
+              f" failed, {fleet['failovers']} failovers, drained in "
+              f"{fleet['drained_after_s']}s, survivors "
+              f"{fleet['survivors']}")
+    gates.update({"quant_ok": quant["ok"], "fleet_ok": fleet["ok"]})
+
     out = {
-        "round": 12,
+        "round": 13,
         "what": ("serve latency x offered load (closed + open loop), "
                  "saturation throughput, bucket utilization, tail + "
-                 "padded-parity gates, plus the robustness arms — "
+                 "padded-parity gates, the robustness arms — "
                  "overload shedding, multi-model interference, canary "
                  "auto-rollback, dead-request hygiene, serve faults — "
-                 "through ServeEngine+DynamicBatcher+admission"),
+                 "plus the int8 quantized rollout (calibration artifact "
+                 "-> canary-gated promotion -> residency/throughput) "
+                 "and the multi-host fleet dead-host drain scenario, "
+                 "through ServeEngine+DynamicBatcher+admission+fleet"),
         "smoke": bool(args.smoke),
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
@@ -671,6 +1062,8 @@ def main():
             "dead_request_hygiene": hyg,
             "serve_faults": flt,
         },
+        "quantized": quant,
+        "fleet": fleet,
         "gates": gates,
         "bench_wall_s": round(time.time() - t_bench, 1),
     }

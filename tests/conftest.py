@@ -110,6 +110,12 @@ _FAST_MODULES = {
     # precedent) — the shed/rollback/disconnect-hygiene acceptance bars
     # MUST hold in tier 1
     "test_serve_admission", "test_serve_http",
+    # quantized serving + fleet (ISSUE 18): quant/calibration units and
+    # the canary top-1 gate reuse the tiny resnet18@32 ladder (the
+    # test_serve precedent; the CLI end-to-end is opted out per-test);
+    # the fleet tier is pure stdlib threads + loopback HTTP — the
+    # zero-failed-failover acceptance bar MUST hold in tier 1
+    "test_serve_quant", "test_fleet",
 }
 
 

@@ -119,8 +119,17 @@ def test_budget_table_reproduces_analytic_formulas(computed_budgets):
     want_dcn = 2 * (s - 1) / s * g / inner + 2 * (n - 1) / n * p
     assert abs(link["ici"]["total"] - want_ici) / want_ici < 0.02
     assert abs(link["dcn"]["total"] - want_dcn) / want_dcn < 0.02
-    for row in cfg.values():
+    for name, row in cfg.items():
         assert row["f64_shapes"] == 0
+        if name == "serve_quant":
+            # an inference forward donates nothing; its row gates the
+            # REQUESTED matmul dtypes instead — every dot bf16, s8
+            # parameters present, no silent fp32 fallback
+            assert row["s8_params"] >= 1
+            assert row["dots"].get("bf16", 0) >= 1
+            assert not row["dots"].get("f32", 0) \
+                and not row["dots"].get("f64", 0)
+            continue
         assert row["alias_entries"] >= \
             computed_budgets["model"]["param_leaves"]
 

@@ -1,0 +1,370 @@
+"""Serve-fleet tier units (ISSUE 18 tentpole c).
+
+Pure stdlib: membership + heartbeat verdicts over the quorum KV dir,
+joined-shortest-queue picking, the zero-failed-in-flight failover
+acceptance bar (transport death retried, HTTP answers returned), and
+the admission-fronted fleet HTTP front — all against loopback stub
+members, no engine, no compiles.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dptpu import obs
+from dptpu.resilience.quorum import FileKVStore
+from dptpu.serve.admission import AdmissionError
+from dptpu.serve.fleet import (
+    BEAT_PREFIX,
+    MEMBER_PREFIX,
+    FleetMember,
+    FleetRouter,
+    FleetUnavailable,
+    make_fleet_handler,
+)
+
+# routers in these tests poll manually (_poll_once) for determinism;
+# the background poll thread is parked on a long period
+_PARKED = 3600.0
+
+
+def _counter(name: str) -> float:
+    return float(obs.get_registry().scalars().get(name, 0.0))
+
+
+def _stub_member_server(reply: dict, status: int = 200):
+    """A loopback stub member: answers every POST with ``reply``."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            out = json.dumps({**reply, "echo_bytes": len(body),
+                              "path": self.path}).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd
+
+
+def _dead_socket():
+    """A listener that accepts and immediately closes every connection —
+    deterministic transport death (what a killed serve host looks like
+    to the router mid-request)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def reap():
+        while True:
+            try:
+                conn, _ = srv.accept()
+                conn.close()
+            except OSError:
+                return
+
+    threading.Thread(target=reap, daemon=True).start()
+    return srv
+
+
+def _register(store, member_id, port, *, beat_age_s=0.0, draining=False,
+              load=None):
+    """Hand-write a member's registration + beat (what FleetMember does,
+    minus the thread — lets tests pin ages exactly)."""
+    store.put(MEMBER_PREFIX + member_id, json.dumps({
+        "host": "127.0.0.1", "port": port, "pid": 0,
+        "registered_ts": time.time(),
+    }))
+    beat = {"ts": time.time() - beat_age_s}
+    if draining:
+        beat["draining"] = True
+    if load is not None:
+        beat["load"] = load
+    store.put(BEAT_PREFIX + member_id, json.dumps(beat))
+
+
+# ------------------------------------------------------- membership ----
+
+
+def test_member_registers_beats_and_tombstones(tmp_path):
+    store = FileKVStore(str(tmp_path))
+    m = FleetMember(str(tmp_path), host="127.0.0.1", port=4242,
+                    heartbeat_s=0.05,
+                    load_fn=lambda: {"Serve/completed": 7.0})
+    try:
+        reg = json.loads(store.scan(MEMBER_PREFIX)[
+            MEMBER_PREFIX + m.member_id])
+        assert reg["host"] == "127.0.0.1" and reg["port"] == 4242
+        # first beat landed synchronously in the constructor
+        beat = json.loads(store.scan(BEAT_PREFIX)[
+            BEAT_PREFIX + m.member_id])
+        assert beat["ts"] > 0 and beat["load"] == {"Serve/completed": 7.0}
+        ts0 = beat["ts"]
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            beat = json.loads(store.scan(BEAT_PREFIX)[
+                BEAT_PREFIX + m.member_id])
+            if beat["ts"] > ts0:
+                break
+            time.sleep(0.02)
+        assert beat["ts"] > ts0, "heartbeat thread never re-beat"
+    finally:
+        m.close()
+    beat = json.loads(store.scan(BEAT_PREFIX)[BEAT_PREFIX + m.member_id])
+    assert beat.get("draining") is True
+
+
+def test_member_broken_load_fn_does_not_stop_beats(tmp_path):
+    def boom():
+        raise RuntimeError("meter on fire")
+
+    m = FleetMember(str(tmp_path), host="127.0.0.1", port=1,
+                    heartbeat_s=0.05, load_fn=boom)
+    try:
+        beat = json.loads(FileKVStore(str(tmp_path)).scan(BEAT_PREFIX)[
+            BEAT_PREFIX + m.member_id])
+        assert beat["load"] == {}
+    finally:
+        m.close()
+
+
+def test_router_membership_verdicts(tmp_path):
+    store = FileKVStore(str(tmp_path))
+    _register(store, "alive", 1001, load={"Serve/completed": 3.0})
+    _register(store, "stale", 1002, beat_age_s=60.0)
+    _register(store, "gone", 1003, draining=True)
+    r = FleetRouter(str(tmp_path), deadline_s=3.0, poll_s=_PARKED)
+    try:
+        members = r.members()
+        assert set(members) == {"alive"}
+        assert members["alive"]["port"] == 1001
+        assert members["alive"]["load"] == {"Serve/completed": 3.0}
+        # a member that resumes beating re-enters on the next poll —
+        # drain is a routing verdict, not an expulsion
+        _register(store, "stale", 1002)
+        r._poll_once()
+        assert set(r.members()) == {"alive", "stale"}
+    finally:
+        r.close()
+
+
+def test_router_drains_on_tombstone_and_counts(tmp_path):
+    before = _counter("Fleet/drains")
+    store = FileKVStore(str(tmp_path))
+    _register(store, "m1", 1001)
+    r = FleetRouter(str(tmp_path), deadline_s=3.0, poll_s=_PARKED)
+    try:
+        assert set(r.members()) == {"m1"}
+        _register(store, "m1", 1001, draining=True)
+        r._poll_once()
+        assert r.members() == {}
+        assert r.stats()["drains"] == 1
+        assert _counter("Fleet/drains") == before + 1
+        ready, reasons = r.readiness()
+        assert not ready and "no healthy members" in reasons[0]
+    finally:
+        r.close()
+
+
+def test_pick_joined_shortest_queue(tmp_path):
+    store = FileKVStore(str(tmp_path))
+    _register(store, "a", 1001)
+    _register(store, "b", 1002)
+    r = FleetRouter(str(tmp_path), deadline_s=3.0, poll_s=_PARKED)
+    try:
+        first = r._pick(set())       # a (tie -> lexicographic min)
+        second = r._pick(set())      # b now has fewer in-flight
+        assert {first[0], second[0]} == {"a", "b"}
+        third = r._pick(set())       # tie again
+        r._release(third[0])
+        assert r._pick({"a"})[0] == "b"
+        assert r._pick({"a", "b"}) is None
+    finally:
+        r.close()
+
+
+# ----------------------------------------------------- request path ----
+
+
+def test_forward_failover_zero_failed_requests(tmp_path):
+    """The acceptance bar: a member dying mid-load costs failovers,
+    never a failed request — every forward answers 200 via the
+    surviving member."""
+    dead = _dead_socket()
+    live = _stub_member_server({"member": "live"})
+    store = FileKVStore(str(tmp_path))
+    _register(store, "dead", dead.getsockname()[1])
+    _register(store, "live", live.server_address[1])
+    failovers0 = _counter("Fleet/failovers")
+    r = FleetRouter(str(tmp_path), deadline_s=3600.0, poll_s=_PARKED,
+                    retries=2)
+    try:
+        for i in range(20):
+            status, data = r.forward("/predict", b"x" * (i + 1))
+            assert status == 200
+            reply = json.loads(data)
+            assert reply["member"] == "live"
+            assert reply["echo_bytes"] == i + 1
+        assert _counter("Fleet/failovers") > failovers0
+        # no in-flight leaks after the storm
+        assert all(v == 0 for v in r.stats()["inflight"].values())
+    finally:
+        r.close()
+        live.shutdown()
+        dead.close()
+
+
+def test_forward_http_error_is_an_answer_not_a_retry(tmp_path):
+    """A member's 4xx/5xx is returned to the client; only transport
+    death fails over."""
+    teapot = _stub_member_server({"member": "teapot"}, status=418)
+    store = FileKVStore(str(tmp_path))
+    _register(store, "teapot", teapot.server_address[1])
+    failovers0 = _counter("Fleet/failovers")
+    r = FleetRouter(str(tmp_path), deadline_s=3600.0, poll_s=_PARKED)
+    try:
+        status, _ = r.forward("/predict", b"x")
+        assert status == 418
+        assert _counter("Fleet/failovers") == failovers0
+    finally:
+        r.close()
+        teapot.shutdown()
+
+
+def test_forward_empty_fleet_raises_unavailable(tmp_path):
+    r = FleetRouter(str(tmp_path), deadline_s=3.0, poll_s=_PARKED)
+    try:
+        with pytest.raises(FleetUnavailable) as ei:
+            r.forward("/predict", b"x")
+        assert ei.value.status == 503
+        assert ei.value.retry_after_s == 1.0
+    finally:
+        r.close()
+
+
+def test_forward_all_members_dead_raises_after_retries(tmp_path):
+    dead = _dead_socket()
+    store = FileKVStore(str(tmp_path))
+    _register(store, "dead", dead.getsockname()[1])
+    r = FleetRouter(str(tmp_path), deadline_s=3600.0, poll_s=_PARKED,
+                    retries=2)
+    try:
+        with pytest.raises(FleetUnavailable, match="failover"):
+            r.forward("/predict", b"x")
+    finally:
+        r.close()
+        dead.close()
+
+
+def test_submit_admission_fronts_the_fleet(tmp_path):
+    live = _stub_member_server({"member": "live"})
+    store = FileKVStore(str(tmp_path))
+    _register(store, "live", live.server_address[1])
+    r = FleetRouter(str(tmp_path), deadline_s=3600.0, poll_s=_PARKED,
+                    queue_depth=1)
+    try:
+        status, _ = r.submit("/predict", b"x")
+        assert status == 200
+        st = r.stats()["admission"]
+        assert st["admitted"] >= 1
+        # occupancy released even on FleetUnavailable (the except path)
+        _register(store, "live", 1, draining=True)  # kill route table
+        r._poll_once()
+        with pytest.raises(AdmissionError):
+            r.submit("/predict", b"x")
+        status_after = r.stats()["admission"]
+        assert status_after["occupancy"] == 0
+    finally:
+        r.close()
+        live.shutdown()
+
+
+# ------------------------------------------------------- HTTP front ----
+
+
+@pytest.fixture()
+def fleet_front(tmp_path):
+    live = _stub_member_server({"member": "live"})
+    store = FileKVStore(str(tmp_path))
+    _register(store, "live", live.server_address[1])
+    r = FleetRouter(str(tmp_path), deadline_s=3600.0, poll_s=_PARKED)
+    front = ThreadingHTTPServer(("127.0.0.1", 0), make_fleet_handler(r))
+    t = threading.Thread(target=front.serve_forever, daemon=True)
+    t.start()
+    yield {"router": r, "front": front, "member": live, "store": store}
+    front.shutdown()
+    r.close()
+    live.shutdown()
+
+
+def _http(front, method, path, body=None, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(*front.server_address, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_front_health_and_routes(fleet_front):
+    front = fleet_front["front"]
+    status, data, _ = _http(front, "GET", "/healthz")
+    assert status == 200
+    assert json.loads(data)["members"] == ["live"]
+    status, data, _ = _http(front, "GET", "/readyz")
+    assert status == 200 and json.loads(data)["ready"] is True
+    status, data, _ = _http(front, "GET", "/metrics")
+    assert status == 200
+    payload = json.loads(data)
+    assert "live" in payload["fleet"]["members"]
+    status, _, _ = _http(front, "GET", "/nope")
+    assert status == 404
+
+
+def test_front_forwards_predict(fleet_front):
+    front = fleet_front["front"]
+    status, data, _ = _http(front, "POST", "/predict/resnet18", b"abc")
+    assert status == 200
+    reply = json.loads(data)
+    assert reply["member"] == "live"
+    assert reply["path"] == "/predict/resnet18"
+    assert reply["echo_bytes"] == 3
+
+
+def test_front_rejects_missing_body_and_unknown_route(fleet_front):
+    front = fleet_front["front"]
+    status, data, _ = _http(front, "POST", "/predict")
+    assert status == 400
+    assert "body" in json.loads(data)["error"]
+    status, _, _ = _http(front, "POST", "/other", b"x")
+    assert status == 404
+
+
+def test_front_sheds_503_with_retry_after_when_fleet_empty(fleet_front):
+    front = fleet_front["front"]
+    store = fleet_front["store"]
+    router = fleet_front["router"]
+    _register(store, "live", 1, draining=True)
+    router._poll_once()
+    status, data, headers = _http(front, "POST", "/predict", b"x")
+    assert status == 503
+    assert "Retry-After" in headers
+    assert "healthy members" in json.loads(data)["error"]
+    status, data, _ = _http(front, "GET", "/readyz")
+    assert status == 503 and json.loads(data)["ready"] is False

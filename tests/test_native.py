@@ -132,3 +132,106 @@ def test_val_pipeline_routes_to_exact_pil_path(tmp_path):
     out = np.empty((224, 224, 3), np.uint8)
     ds.get_into(0, np.random.default_rng(0), out)
     np.testing.assert_array_equal(out, got.astype(np.uint8))
+
+
+# ---------------------------------------------------- serve-ingest (ISSUE 18)
+
+
+def _serve_lib():
+    from dptpu.native.build import load_library
+
+    lib = load_library()
+    if lib is None or not hasattr(lib, "dptpu_serve_ingest"):
+        pytest.skip("native lib without dptpu_serve_ingest")
+    return lib
+
+
+def test_serve_ingest_bit_identical_to_pil_matrix():
+    """The fused serve-ingest kernel byte-matches the PIL val path —
+    BIT-identity, not closeness — across geometries that exercise every
+    resample branch: odd dims, portrait/landscape, square, enlarge
+    (source smaller than the resize edge), progressive scan, 4:4:4."""
+    from dptpu.serve.preprocess import _pil_val_pixels, val_resize_for
+
+    lib = _serve_lib()
+    rng = np.random.RandomState(0)
+    cases = []
+    for (w, h), kw in [
+        ((337, 251), {"quality": 85}),
+        ((251, 337), {"quality": 85}),
+        ((224, 224), {"quality": 92}),
+        ((96, 80), {"quality": 90}),        # box-ENLARGE path
+        ((230, 310), {"quality": 85, "progressive": True}),
+        ((301, 200), {"quality": 95, "subsampling": 0}),
+    ]:
+        buf = io.BytesIO()
+        Image.fromarray(
+            rng.randint(0, 256, (h, w, 3), np.uint8)
+        ).save(buf, "JPEG", **kw)
+        cases.append(buf.getvalue())
+    for size in (224, 32):
+        resize = val_resize_for(size)
+        for data in cases:
+            ref = _pil_val_pixels(data, size, resize)
+            out = np.empty((size, size, 3), np.uint8)
+            rc = lib.dptpu_serve_ingest(data, len(data), size, resize,
+                                        out.ctypes.data)
+            assert rc == 0
+            np.testing.assert_array_equal(out, ref)
+
+
+def test_serve_ingest_grayscale_matches_pil_convert():
+    from dptpu.serve.preprocess import _pil_val_pixels
+
+    lib = _serve_lib()
+    rng = np.random.RandomState(1)
+    buf = io.BytesIO()
+    Image.fromarray(rng.randint(0, 256, (200, 300), np.uint8), "L").save(
+        buf, "JPEG", quality=88
+    )
+    data = buf.getvalue()
+    out = np.empty((224, 224, 3), np.uint8)
+    rc = lib.dptpu_serve_ingest(data, len(data), 224, 256, out.ctypes.data)
+    assert rc == 0
+    np.testing.assert_array_equal(out, _pil_val_pixels(data, 224, 256))
+
+
+def test_serve_ingest_bails_negative_on_cmyk_and_garbage():
+    """Per-image bails return negative (caller falls to PIL) instead of
+    writing wrong pixels."""
+    lib = _serve_lib()
+    out = np.empty((224, 224, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(
+        np.random.RandomState(2).randint(0, 256, (60, 80, 4), np.uint8),
+        "CMYK",
+    ).save(buf, "JPEG")
+    data = buf.getvalue()
+    assert lib.dptpu_serve_ingest(data, len(data), 224, 256,
+                                  out.ctypes.data) < 0
+    bad = b"\xff\xd8\xff" + b"garbage" * 16
+    assert lib.dptpu_serve_ingest(bad, len(bad), 224, 256,
+                                  out.ctypes.data) < 0
+
+
+def test_preprocess_bytes_uses_native_only_after_probe(monkeypatch):
+    """The probe gate: when the probe says the kernel is not
+    bit-identical on this host, preprocess_bytes stays on PIL — same
+    pixels, loudly."""
+    from dptpu.serve import preprocess as pp
+
+    _serve_lib()
+    rng = np.random.RandomState(3)
+    buf = io.BytesIO()
+    Image.fromarray(rng.randint(0, 256, (180, 260, 3), np.uint8)).save(
+        buf, "JPEG", quality=85
+    )
+    data = buf.getvalue()
+    ref = pp._pil_val_pixels(data, 224, 256)
+
+    monkeypatch.setattr(pp, "_NATIVE_INGEST_OK", False)
+    np.testing.assert_array_equal(pp.preprocess_bytes(data), ref)
+
+    monkeypatch.setattr(pp, "_NATIVE_INGEST_OK", None)  # force re-probe
+    np.testing.assert_array_equal(pp.preprocess_bytes(data), ref)
+    assert pp._NATIVE_INGEST_OK is True  # probe ran and passed here

@@ -26,7 +26,15 @@ _KNOBS = ("DPTPU_SERVE_BUCKETS", "DPTPU_SERVE_MAX_DELAY_MS",
           "DPTPU_SERVE_PLACEMENT", "DPTPU_SERVE_SLOTS",
           "DPTPU_SERVE_QUEUE_DEPTH", "DPTPU_SERVE_PRIORITIES",
           "DPTPU_SERVE_DEADLINE_MS", "DPTPU_SERVE_CANARY_FRACTION",
-          "DPTPU_SERVE_CANARY_DRIFT", "DPTPU_SERVE_CANARY_LAT_FACTOR")
+          "DPTPU_SERVE_CANARY_DRIFT", "DPTPU_SERVE_CANARY_LAT_FACTOR",
+          "DPTPU_QUANT_PRECISION", "DPTPU_QUANT_CALIB",
+          "DPTPU_QUANT_DRIFT", "DPTPU_QUANT_TOP1_MIN",
+          "DPTPU_FLEET_DIR", "DPTPU_FLEET_HEARTBEAT_S",
+          "DPTPU_FLEET_DEADLINE_S", "DPTPU_FLEET_RETRIES")
+
+# the quant/fleet tail every pre-ISSUE-18 knob tuple ends with when the
+# new knobs are left at their defaults
+_QF_DEFAULT_TAIL = ("fp32", "", 0.0, 0.0, "", 1.0, 3.0, 2)
 
 
 @pytest.fixture(autouse=True)
@@ -40,7 +48,8 @@ def test_defaults():
     assert k == (DEFAULT_BUCKETS, DEFAULT_MAX_DELAY_MS, "auto",
                  DEFAULT_SLOTS, DEFAULT_QUEUE_DEPTH, DEFAULT_PRIORITIES,
                  DEFAULT_DEADLINE_MS, DEFAULT_CANARY_FRACTION,
-                 DEFAULT_CANARY_DRIFT, DEFAULT_CANARY_LAT_FACTOR)
+                 DEFAULT_CANARY_DRIFT, DEFAULT_CANARY_LAT_FACTOR,
+                 *_QF_DEFAULT_TAIL)
 
 
 def test_env_overrides_cli_values(monkeypatch):
@@ -59,7 +68,7 @@ def test_env_overrides_cli_values(monkeypatch):
                     deadline_ms=10.0, canary_fraction=0.5,
                     canary_drift=1.0, canary_lat_factor=2.0)
     assert k == ((2, 8), 12.5, "replicated", 6, 32, (1.0, 0.5, 0.25),
-                 250.0, 0.25, 7.5, 3.0)
+                 250.0, 0.25, 7.5, 3.0, *_QF_DEFAULT_TAIL)
 
 
 def test_cli_values_pass_through():
@@ -69,7 +78,7 @@ def test_cli_values_pass_through():
                     canary_fraction=0.2, canary_drift=2.0,
                     canary_lat_factor=4.0)
     assert k == ((1, 2, 4), 0.0, "replicated", 3, 16, (1.0, 0.75, 0.5),
-                 100.0, 0.2, 2.0, 4.0)
+                 100.0, 0.2, 2.0, 4.0, *_QF_DEFAULT_TAIL)
 
 
 def test_buckets_must_be_sorted_positive():
@@ -235,3 +244,95 @@ def test_engine_validates_placement_fail_fast():
         "replicated"
     assert resolve_placement("vit_b_16", "auto", device_count=1) == \
         "replicated"
+
+
+# ------------------------------------- quant / fleet knobs (ISSUE 18) ----
+
+
+def test_quant_precision_validated(monkeypatch):
+    monkeypatch.setenv("DPTPU_QUANT_PRECISION", "fp16")
+    with pytest.raises(ValueError, match="DPTPU_QUANT_PRECISION"):
+        serve_knobs()
+    monkeypatch.delenv("DPTPU_QUANT_PRECISION")
+    with pytest.raises(ValueError, match="--precision"):
+        serve_knobs(precision="int4")
+
+
+def test_sub_fp32_requires_calibration_artifact(monkeypatch):
+    # the never-silent lock: int8/bf16 without a provenance-stamped
+    # artifact refuses pre-compile, naming `dptpu quantize`
+    for prec in ("int8", "bf16"):
+        with pytest.raises(ValueError, match="dptpu quantize"):
+            serve_knobs(precision=prec)
+    k = serve_knobs(precision="int8", calib="/tmp/c.dptpu")
+    assert k.precision == "int8" and k.calib == "/tmp/c.dptpu"
+    # fp32 needs none
+    assert serve_knobs(precision="fp32").calib == ""
+    # env calib satisfies an env precision
+    monkeypatch.setenv("DPTPU_QUANT_PRECISION", "bf16")
+    monkeypatch.setenv("DPTPU_QUANT_CALIB", "/tmp/e.dptpu")
+    assert serve_knobs().calib == "/tmp/e.dptpu"
+
+
+def test_quant_gate_overrides_validated(monkeypatch):
+    with pytest.raises(ValueError, match="DPTPU_QUANT_DRIFT"):
+        serve_knobs(environ={"DPTPU_QUANT_DRIFT": "-0.5"})
+    with pytest.raises(ValueError, match="--quant-drift"):
+        serve_knobs(quant_drift=-1.0)
+    with pytest.raises(ValueError, match="DPTPU_QUANT_TOP1_MIN"):
+        serve_knobs(environ={"DPTPU_QUANT_TOP1_MIN": "1.5"})
+    with pytest.raises(ValueError, match="--quant-top1-min"):
+        serve_knobs(quant_top1_min=-0.1)
+    # 0 is VALID for both: enforce the artifact's own bounds
+    k = serve_knobs(quant_drift=0.0, quant_top1_min=0.0)
+    assert k.quant_drift == 0.0 and k.quant_top1_min == 0.0
+    monkeypatch.setenv("DPTPU_QUANT_DRIFT", "0.25")
+    monkeypatch.setenv("DPTPU_QUANT_TOP1_MIN", "0.9")
+    k = serve_knobs(quant_drift=9.0, quant_top1_min=0.1)
+    assert k.quant_drift == 0.25 and k.quant_top1_min == 0.9
+
+
+def test_fleet_heartbeat_and_deadline_validated(monkeypatch):
+    with pytest.raises(ValueError, match="DPTPU_FLEET_HEARTBEAT_S"):
+        serve_knobs(environ={"DPTPU_FLEET_HEARTBEAT_S": "0"})
+    with pytest.raises(ValueError, match="--fleet-heartbeat-s"):
+        serve_knobs(fleet_heartbeat_s=-1.0)
+    # the deadline must EXCEED the beat period or every member flaps
+    with pytest.raises(ValueError, match="exceed the heartbeat"):
+        serve_knobs(fleet_heartbeat_s=2.0, fleet_deadline_s=2.0)
+    with pytest.raises(ValueError, match="DPTPU_FLEET_DEADLINE_S"):
+        serve_knobs(environ={"DPTPU_FLEET_DEADLINE_S": "0.5"})
+    k = serve_knobs(fleet_heartbeat_s=0.5, fleet_deadline_s=1.5)
+    assert k.fleet_heartbeat_s == 0.5 and k.fleet_deadline_s == 1.5
+    monkeypatch.setenv("DPTPU_FLEET_HEARTBEAT_S", "0.25")
+    monkeypatch.setenv("DPTPU_FLEET_DEADLINE_S", "0.75")
+    k = serve_knobs(fleet_heartbeat_s=9.0, fleet_deadline_s=99.0)
+    assert k.fleet_heartbeat_s == 0.25 and k.fleet_deadline_s == 0.75
+
+
+def test_fleet_retries_and_dir(monkeypatch):
+    with pytest.raises(ValueError, match="DPTPU_FLEET_RETRIES"):
+        serve_knobs(environ={"DPTPU_FLEET_RETRIES": "-1"})
+    with pytest.raises(ValueError, match="--fleet-retries"):
+        serve_knobs(fleet_retries=-2)
+    # 0 is VALID: failover disabled, deaths surface to the client
+    assert serve_knobs(fleet_retries=0).fleet_retries == 0
+    monkeypatch.setenv("DPTPU_FLEET_DIR", "/tmp/fleet-env")
+    monkeypatch.setenv("DPTPU_FLEET_RETRIES", "5")
+    k = serve_knobs(fleet_dir="/tmp/fleet-cli", fleet_retries=1)
+    assert k.fleet_dir == "/tmp/fleet-env" and k.fleet_retries == 5
+
+
+def test_cli_quant_fleet_flags_pass_through():
+    p = build_serve_parser()
+    args = p.parse_args([
+        "-a", "resnet18", "--precision", "int8", "--calib", "/tmp/c",
+        "--quant-drift", "0.5", "--quant-top1-min", "0.9",
+        "--fleet-dir", "/tmp/fl", "--fleet-heartbeat-s", "0.5",
+        "--fleet-deadline-s", "2.0", "--fleet-retries", "3",
+    ])
+    k = serve_args_to_knobs(args)
+    assert k.precision == "int8" and k.calib == "/tmp/c"
+    assert k.quant_drift == 0.5 and k.quant_top1_min == 0.9
+    assert k.fleet_dir == "/tmp/fl" and k.fleet_heartbeat_s == 0.5
+    assert k.fleet_deadline_s == 2.0 and k.fleet_retries == 3

@@ -1,0 +1,371 @@
+"""Quantized serving locks (ISSUE 18).
+
+* ops-level per-channel int8 round trip and the quantized-leaf marker
+  contract (``dptpu/ops/quant.py``);
+* the calibration artifact: ``dptpu quantize`` end to end, CRC seal,
+  and the loader's fail-fast chain — every refusal NAMES the
+  recalibration command (the satellite lock);
+* the engine's precision axis: int8/bf16 generations on the bucket
+  ladder, drift vs fp32 bounded, ≥40% resident-bytes reduction for the
+  int8 generation (the acceptance lever);
+* the canary top-1 agreement gate: a disagreeing rollout rolls back
+  naming the agreement deficit; a quantized rollout under the
+  artifact's bounds promotes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dptpu.ops.quant import (
+    cast_tree,
+    channel_scales,
+    dequantize_leaf,
+    dequantize_tree,
+    is_quantized_leaf,
+    quantize_leaf,
+    quantize_tree,
+    scales_tree,
+    tree_nbytes,
+)
+from dptpu.serve import ServeEngine
+from dptpu.serve.batcher import DynamicBatcher
+from dptpu.serve.canary import CanaryController
+from dptpu.serve.quant import (
+    CalibrationError,
+    load_calibration,
+    measure_drift,
+    quantize_variables,
+    save_calibration,
+    weights_fingerprint,
+)
+
+ARCH = "resnet18"
+
+
+def _rand_images(n, size, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, size, size, 3), np.uint8
+    )
+
+
+def _fresh_variables(engine, seed):
+    init = engine.model.init(
+        jax.random.PRNGKey(seed),
+        np.zeros((1, engine.image_size, engine.image_size, 3), np.float32),
+        train=False,
+    )
+    return {"params": init["params"],
+            "batch_stats": init.get("batch_stats", {})}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ServeEngine(ARCH, buckets=(1, 4), num_classes=8,
+                       image_size=32, placement="replicated")
+
+
+def _host_params(engine):
+    return jax.tree_util.tree_map(
+        np.asarray, engine._host_variables["params"]
+    )
+
+
+def _artifact(engine, tmp_path, name="calib.dptpu", **over):
+    params = _host_params(engine)
+    kw = dict(
+        arch=ARCH, params=params,
+        stats={"top1_agreement": 0.95, "max_abs_dlogit": 0.03},
+        bounds={"min_top1_agreement": 0.5, "max_abs_dlogit": 10.0},
+        num_classes=8, image_size=32, sample_n=8,
+    )
+    kw.update(over)
+    path = str(tmp_path / name)
+    save_calibration(path, **kw)
+    return path
+
+
+# ------------------------------------------------------------- ops ----
+
+
+def test_quantize_leaf_roundtrip_bound():
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 32).astype(np.float32) * 0.1
+    q, scale = quantize_leaf(w)
+    assert q.dtype == jnp.int8 and scale.shape == (32,)
+    np.testing.assert_array_equal(scale, channel_scales(w))
+    back = np.asarray(dequantize_leaf(q, scale, jnp.float32))
+    # symmetric absmax: error per element <= scale/2 = absmax/254
+    err = np.abs(back - w)
+    assert np.all(err <= np.asarray(scale) / 2 + 1e-7)
+
+
+def test_quantize_tree_marker_and_passthrough():
+    rng = np.random.RandomState(1)
+    tree = {
+        "dense": {"kernel": rng.randn(8, 4).astype(np.float32),
+                  "bias": rng.randn(4).astype(np.float32)},
+        "norm": {"scale": np.ones(4, np.float32)},
+    }
+    qt = quantize_tree(tree)
+    assert is_quantized_leaf(qt["dense"]["kernel"])
+    # bias and norm params stay fp32, untouched
+    np.testing.assert_array_equal(qt["dense"]["bias"],
+                                  tree["dense"]["bias"])
+    assert not is_quantized_leaf(qt["norm"])
+    back = dequantize_tree(qt, jnp.float32)
+    assert np.abs(
+        np.asarray(back["dense"]["kernel"]) - tree["dense"]["kernel"]
+    ).max() < 0.05
+    # size ordering: int8 < bf16 < fp32 residency
+    n_fp32 = tree_nbytes(tree)
+    n_bf16 = tree_nbytes(cast_tree(tree, jnp.bfloat16))
+    n_int8 = tree_nbytes(qt)
+    assert n_int8 < n_bf16 < n_fp32
+
+
+def test_scales_tree_placeholders_recomputed():
+    rng = np.random.RandomState(2)
+    tree = {"k": rng.randn(6, 3).astype(np.float32),
+            "b": rng.randn(3).astype(np.float32)}
+    st = scales_tree(tree)
+    assert st["k"].shape == (3,)
+    assert st["b"].size == 0  # non-quantizable placeholder
+    # quantize_tree must treat the placeholder as "recompute", not as a
+    # literal zero-length scale
+    qt = quantize_tree(tree, st)
+    assert is_quantized_leaf(qt["k"])
+    np.testing.assert_array_equal(qt["b"], tree["b"])
+
+
+def test_measure_drift_shapes():
+    a = np.zeros((4, 8), np.float32)
+    b = a.copy()
+    b[0, 0] = 0.5
+    agree, drift = measure_drift(a, b)
+    assert drift == pytest.approx(0.5)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        measure_drift(a, np.zeros((4, 9), np.float32))
+
+
+# -------------------------------------------------- artifact loader ----
+
+
+def test_calibration_roundtrip(engine, tmp_path):
+    path = _artifact(engine, tmp_path)
+    payload = load_calibration(path, arch=ARCH,
+                               params=_host_params(engine))
+    meta = payload["meta"]
+    assert meta["arch"] == ARCH
+    assert meta["scheme"].startswith("absmax-int8")
+    assert meta["bounds"]["max_abs_dlogit"] == 10.0
+    assert "host" in meta  # provenance stamp
+    assert meta["weights_fingerprint"] == weights_fingerprint(
+        _host_params(engine)
+    )
+    assert "scales" in payload
+
+
+def test_calibration_loader_fail_fast_names_recalibration(engine,
+                                                          tmp_path):
+    """The satellite lock: EVERY load failure is a CalibrationError
+    whose message names the ``dptpu quantize`` command."""
+    params = _host_params(engine)
+
+    # missing file
+    with pytest.raises(CalibrationError, match="dptpu quantize"):
+        load_calibration(str(tmp_path / "nope.dptpu"), arch=ARCH)
+
+    # empty file (crashed write)
+    empty = tmp_path / "empty.dptpu"
+    empty.write_bytes(b"")
+    with pytest.raises(CalibrationError, match="dptpu quantize"):
+        load_calibration(str(empty), arch=ARCH)
+
+    # garbage without a CRC footer is NOT an artifact
+    garbage = tmp_path / "garbage.dptpu"
+    garbage.write_bytes(b"not an artifact at all")
+    with pytest.raises(CalibrationError, match="dptpu quantize"):
+        load_calibration(str(garbage), arch=ARCH)
+
+    # bit rot under the seal: flip one payload byte
+    path = _artifact(engine, tmp_path, name="rot.dptpu")
+    raw = bytearray(open(path, "rb").read())
+    raw[10] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(CalibrationError, match="dptpu quantize"):
+        load_calibration(path, arch=ARCH)
+
+    # arch mismatch names BOTH the wrong arch and the command
+    path = _artifact(engine, tmp_path, name="arch.dptpu")
+    with pytest.raises(CalibrationError) as ei:
+        load_calibration(path, arch="vit_b_32")
+    assert "calibrated for arch" in str(ei.value)
+    assert "dptpu quantize --arch vit_b_32" in str(ei.value)
+
+    # weights-generation mismatch (stale scales = the silent-drift path)
+    path = _artifact(engine, tmp_path, name="gen.dptpu")
+    other = jax.tree_util.tree_map(lambda a: a + 1.0, params)
+    with pytest.raises(CalibrationError) as ei:
+        load_calibration(path, arch=ARCH, params=other)
+    assert "stale scales drift silently" in str(ei.value)
+    assert "dptpu quantize" in str(ei.value)
+
+
+# ------------------------------------------------- engine precision ----
+
+
+def test_engine_precision_axis_int8(engine, tmp_path):
+    path = _artifact(engine, tmp_path, name="engine.dptpu")
+    base = engine.infer(_rand_images(4, 32, seed=3))
+    gen, meta = engine.stage_quantized(path, precision="int8")
+    try:
+        assert engine.generation_precision(gen) == "int8"
+        assert meta["arch"] == ARCH
+        # the ladder compiled an int8 arm for every dedup'd exec size
+        for nexec in {engine.exec_batch(b) for b in engine.buckets}:
+            assert ("int8", nexec) in engine._compiled
+        # ≥40% resident-bytes reduction vs the fp32 generation: the
+        # acceptance lever this host CAN honestly show (2-core CPU)
+        rb = engine.resident_bytes()
+        assert rb[gen] < 0.6 * rb[engine.current_generation]
+        # bounded drift, computed through the real bucket path
+        nexec = engine.exec_batch(4)
+        x = _rand_images(4, 32, seed=3)
+        pad = np.concatenate(
+            [x, np.repeat(x[:1], nexec - 4, axis=0)]
+        ) if nexec > 4 else x
+        q = engine.run_bucket(4, pad, 4, gen=gen)
+        agree, drift = measure_drift(base, q)
+        assert drift < 1.0
+        assert q.dtype == np.float32
+    finally:
+        engine.discard_staged(gen)
+
+
+def test_engine_bf16_generation(engine):
+    variables = quantize_variables(engine._host_variables, "bf16")
+    gen = engine.stage_weights(variables, precision="bf16")
+    try:
+        assert engine.generation_precision(gen) == "bf16"
+        base = engine.infer(_rand_images(2, 32, seed=4))
+        nexec = engine.exec_batch(1)
+        x = np.repeat(_rand_images(1, 32, seed=4), nexec, axis=0)
+        q = engine.run_bucket(1, x, 1, gen=gen)
+        _, drift = measure_drift(base[:1], q)
+        assert drift < 0.5
+    finally:
+        engine.discard_staged(gen)
+
+
+def test_engine_rejects_quantized_tp(engine, monkeypatch):
+    monkeypatch.setattr(engine, "placement", "tp")
+    with pytest.raises(ValueError, match="tp"):
+        engine.stage_weights(
+            quantize_variables(engine._host_variables, "int8"),
+            precision="int8",
+        )
+
+
+def test_stage_quantized_refuses_wrong_arch_artifact(engine, tmp_path):
+    path = _artifact(engine, tmp_path, name="wrong.dptpu",
+                     arch="vit_b_32")
+    with pytest.raises(CalibrationError, match="calibrated for arch"):
+        engine.stage_quantized(path)
+
+
+# ------------------------------------------------------ canary gate ----
+
+
+def test_canary_top1_agreement_gate_rolls_back(engine):
+    """A rollout whose predictions DISAGREE with the baseline rolls
+    back on the cumulative top-1 agreement gate even when the drift
+    gate is disarmed — the quantized deployment's never-silent lock."""
+    canary = CanaryController(engine, fraction=0.5, drift_limit=1e9,
+                              min_batches=2, min_top1_agreement=0.99)
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=2, canary=canary)
+    try:
+        base = engine.current_generation
+        gen = canary.start(_fresh_variables(engine, seed=99))
+        for img in _rand_images(10, 32, seed=5):
+            b.submit_array(img).result(timeout=30)
+        canary.drain_evals()
+        st = canary.status()
+        assert st["state"] == "rolled_back"
+        assert "top-1 agreement" in st["rollback_reason"]
+        assert st["top1_floor"] == 0.99
+        assert engine.current_generation == base
+        assert gen != base
+    finally:
+        b.close()
+        canary.close()
+
+
+def test_canary_quantized_rollout_promotes_under_bounds(engine,
+                                                        tmp_path):
+    path = _artifact(engine, tmp_path, name="promote.dptpu")
+    canary = CanaryController(engine, fraction=0.5, min_batches=2)
+    b = DynamicBatcher(engine, max_delay_ms=0.0, slots=2, canary=canary)
+    try:
+        # operator overrides win over artifact bounds (generous, so the
+        # promotion is deterministic on random-init weights)
+        gen = canary.start_quantized(path, drift_limit=10.0,
+                                     top1_min=0.01)
+        assert engine.generation_precision(gen) == "int8"
+        st = canary.status()
+        assert st["drift_limit"] == 10.0
+        assert st["top1_floor"] == 0.01
+        for i in range(30):
+            b.submit_array(
+                _rand_images(1, 32, seed=20 + i)[0]
+            ).result(timeout=30)
+            canary.drain_evals()
+            if canary.status()["state"] == "promoted":
+                break
+        st = canary.status()
+        assert st["state"] == "promoted"
+        assert st["top1_agreement"] is not None
+        assert engine.current_generation == gen
+        assert engine.generation_precision() == "int8"
+    finally:
+        b.close()
+        canary.close()
+
+
+def test_canary_quantized_artifact_bounds_are_default(engine, tmp_path):
+    path = _artifact(engine, tmp_path, name="bounds.dptpu",
+                     bounds={"min_top1_agreement": 0.125,
+                             "max_abs_dlogit": 7.5})
+    canary = CanaryController(engine, fraction=0.5, min_batches=2)
+    try:
+        gen = canary.start_quantized(path)
+        st = canary.status()
+        assert st["drift_limit"] == 7.5
+        assert st["top1_floor"] == 0.125
+        engine.discard_staged(gen)
+    finally:
+        canary.close()
+
+
+# -------------------------------------------------------- quantize CLI ----
+
+
+@pytest.mark.slow
+def test_quantize_cli_end_to_end(tmp_path):
+    from dptpu.cli import main_quantize
+
+    out = str(tmp_path / "cli.dptpu")
+    meta = main_quantize([
+        "--arch", ARCH, "--out", out, "--num-classes", "8",
+        "--image-size", "32", "--sample", "8",
+    ])
+    assert os.path.exists(out)
+    assert meta["arch"] == ARCH
+    assert meta["stats"]["top1_agreement"] >= 0.0
+    assert meta["bounds"]["max_abs_dlogit"] > 0.0
+    payload = load_calibration(out, arch=ARCH)
+    assert payload["meta"]["sample_n"] == 8
