@@ -108,6 +108,10 @@ KNOB_REGISTRY = {
     "DPTPU_FLEET_HEARTBEAT_S": _k("float", "serve"),
     "DPTPU_FLEET_DEADLINE_S": _k("float", "serve"),
     "DPTPU_FLEET_RETRIES": _k("int", "serve"),
+    # self-tuning control plane (dptpu/tune)
+    "DPTPU_TUNE_ARTIFACT": _k("str", "tune"),
+    "DPTPU_TUNE_CONTROL": _k("str", "tune"),
+    "DPTPU_TUNE_INTERVAL_S": _k("float", "tune"),
     # analysis / sanitizers
     "DPTPU_SYNC_CHECK": _k("bool", "analysis"),
     # bench-driver child sentinels (subprocess re-entry guards)

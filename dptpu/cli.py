@@ -216,6 +216,18 @@ def main_serve(argv=None):
     requests. ``--fleet`` skips the local engine entirely and runs the
     fleet ROUTING TIER over the hosts registered in the fleet dir."""
     args = build_serve_parser().parse_args(argv)
+
+    from dptpu.tune.artifact import apply_tuning, tune_knobs
+
+    # the offline tuning artifact applies BEFORE knob resolution so
+    # serve_knobs sees the tuned ladder — and only for knobs nothing
+    # else set: env twins and explicit CLI flags always win (ISSUE 19)
+    tune_conf = tune_knobs()
+    if tune_conf["artifact"]:
+        cli_set = set()
+        if args.buckets is not None:
+            cli_set.add("DPTPU_SERVE_BUCKETS")  # explicit --buckets wins
+        apply_tuning(tune_conf["artifact"], cli_set=cli_set)
     knobs = serve_args_to_knobs(args)  # fail fast, pre-jax-compile
 
     if args.fleet:
@@ -232,6 +244,26 @@ def main_serve(argv=None):
         )
         for name, arch in specs
     ])
+    if "serve_ladder" in tune_conf["control"]:
+        from dptpu.tune.controller import (
+            Controller,
+            serve_ladder_actuator,
+        )
+
+        # one controller per model, ticked on that model's dispatch
+        # thread between batches: sustained padding waste densifies the
+        # ladder's widest gap (compile-before-publish, bounded budget)
+        for name, m in router.models.items():
+            m.batcher.attach_controller(Controller([
+                serve_ladder_actuator(
+                    m.engine, m.batcher,
+                    interval_s=tune_conf["interval_s"],
+                ),
+            ]))
+        print(f"=> tune control armed: serve_ladder on "
+              f"{', '.join(router.models)} (interval "
+              f"{tune_conf['interval_s']:g}s; disarm with "
+              f"DPTPU_TUNE_CONTROL=off)")
     member = None
     try:
         if knobs.precision != "fp32":
@@ -570,7 +602,9 @@ def main(argv=None):
               "  pack      ImageFolder -> packed sequential shards "
               "(dptpu/data/shards.py)\n"
               "  check     repo-invariant static analysis: AST lints + "
-              "HLO budget gates (dptpu/analysis)")
+              "HLO budget gates (dptpu/analysis)\n"
+              "  tune      offline knob autotuner -> CRC-sealed "
+              "TUNING.json artifact (dptpu/tune)")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "serve":
@@ -583,9 +617,13 @@ def main(argv=None):
         from dptpu.analysis.cli import main_check
 
         return main_check(rest)
+    if cmd == "tune":
+        from dptpu.tune.cli import main_tune
+
+        return main_tune(rest)
     raise SystemExit(
         f"dptpu: unknown subcommand {cmd!r} "
-        f"(available: serve, quantize, pack, check)"
+        f"(available: serve, quantize, pack, check, tune)"
     )
 
 

@@ -550,6 +550,42 @@ class DataLoader:
             self._prev_cache_counts = (0, 0)
         return self._pipeline
 
+    def io_wait_total_s(self) -> float:
+        """Cumulative parent-blocked-on-spans seconds (process mode;
+        0.0 in thread mode), read WITHOUT consuming the ``feed_stats``
+        interval baseline — the tune controller's decode-ahead actuator
+        computes its own intervals, and the obs per-epoch interval must
+        stay exactly what it was."""
+        total = float(self._ring_totals["io_wait_s"])
+        if self._pipeline is not None:
+            total += float(self._pipeline.ring_stats()["io_wait_s"])
+        return total
+
+    def grow_decode_ahead(self, max_ahead: int = 16):
+        """Bounded decode-ahead step (the tune controller's actuator
+        seam, ISSUE 19): deepen the issue window by ONE batch. Takes
+        effect at the next epoch's pipeline build — ``_epoch_process``
+        derives the slot count there and ``_ensure_pipeline`` rebuilds
+        the ring when it grew, so no mid-epoch slot protocol is ever
+        resized under in-flight leases. Returns the new window, or None
+        at the bound / in thread mode (the actuator reads None as "no
+        headroom" and disarms cleanly)."""
+        if self.workers_mode != "process":
+            return None
+        # default window is max(legacy prefetch, 4) — start the bounded
+        # climb from the deepened floor, never below it
+        cur = self.decode_ahead if self.decode_ahead is not None else 4
+        if self.ring_depth is not None:
+            # an explicit ring depth caps the usable window: the pump
+            # can never hold more pending batches than free slots
+            cap = self.ring_depth - 1 \
+                - (self.lease_depth if self.leased else 0)
+            max_ahead = min(max_ahead, cap)
+        if cur >= max_ahead:
+            return None
+        self.decode_ahead = cur + 1
+        return self.decode_ahead
+
     def feed_stats(self) -> dict:
         """Pipeline telemetry for the train loop: worker configuration +
         decode-cache counters (pool-aggregated in process mode).

@@ -33,6 +33,12 @@ class Counter:
     __slots__ = ("value",)
 
     def __init__(self):
+        # owned-by: producer — inc() is a lock-free read-modify-write,
+        # so two producers racing the same counter can tear ONE
+        # increment (undercount a stat, never corrupt: the store itself
+        # is GIL-atomic); the flushing thread reads a possibly-stale
+        # snapshot. The ShmDecodeCache torn-counter trade, recorded in
+        # CONCURRENCY.md.
         self.value = 0.0
 
     def inc(self, n: float = 1.0):
@@ -43,6 +49,9 @@ class Gauge:
     __slots__ = ("value",)
 
     def __init__(self):
+        # owned-by: producer — set() is one GIL-atomic float store;
+        # last writer wins, the flushing thread reads whatever is
+        # current
         self.value = 0.0
 
     def set(self, v: float):
@@ -61,6 +70,11 @@ class Histogram:
     __slots__ = ("_window",)
 
     def __init__(self):
+        # owned-by: producer — observe() is one GIL-atomic list append;
+        # snapshot(reset=True) on the flushing thread swaps in a fresh
+        # list, so an observation landing between the sort and the swap
+        # is dropped from both windows — a bounded per-flush undercount,
+        # not corruption (CONCURRENCY.md known-gaps)
         self._window: List[float] = []
 
     def observe(self, v: float):

@@ -274,6 +274,22 @@ class StragglerController:
         self._count[w] = 0
         self._strikes[w] = 0
 
+    def rebind(self, loader):
+        """Re-point at a REBUILT worker pool (the DPTPU_BATCH_RAMP phase
+        switch closes the old loader and builds a new one at the full
+        batch). Every estimator window, strike count, suspect set, and
+        probation clock resets: worker ids restart from zero in the new
+        pool, so a stale verdict would convict a fresh worker for its
+        predecessor's latency. Escalation totals and the event log
+        carry over — they describe the run, not the pool."""
+        self.loader = loader
+        self._p50.clear()
+        self._count.clear()
+        self._strikes.clear()
+        self._suspect.clear()
+        self._stale_ticks.clear()
+        self._emit("straggler_rebind", {"workers": loader.num_workers})
+
     def tick(self):
         obs = self.loader.worker_latency_observations()
         fresh = {}

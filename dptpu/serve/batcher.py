@@ -212,6 +212,9 @@ class DynamicBatcher:
         self._occupancy_sum = 0.0  # guarded-by: _lock
         self._pad_rows = 0  # guarded-by: _lock
         self._exec_rows = 0  # guarded-by: _lock
+        # tune controller (ISSUE 19): attached before traffic, ticked on
+        # the dispatch thread between batches while holding no lock
+        self._controller = None  # owned-by: caller
         self._latency = obs.get_registry().histogram("Serve/latency_ms")
         self._qps_t0 = time.perf_counter()  # guarded-by: _lock
         self._qps_n0 = 0  # guarded-by: _lock
@@ -398,6 +401,11 @@ class DynamicBatcher:
             slot, reqs = batch
             try:
                 self._run_batch(slot, reqs)
+                if self._controller is not None:
+                    # rate-limited inside; actuator seams take their own
+                    # locks in rank order (batcher 10 -> engine 20) and
+                    # never raise into the dispatch loop
+                    self._controller.tick()
             except Exception as e:
                 # the dispatcher thread must survive ANY batch failure:
                 # a dead dispatcher strands the open slot and blocks
@@ -511,6 +519,18 @@ class DynamicBatcher:
             self._exec_rows += nexec
 
     # -- telemetry / lifecycle ------------------------------------------
+
+    def attach_controller(self, controller) -> None:
+        """Arm a tune controller (ISSUE 19): ticked on the dispatch
+        thread after every batch. Attach before traffic."""
+        self._controller = controller
+
+    def padding_counts(self):
+        """Cumulative ``(pad_rows, exec_rows)`` — the serve-ladder
+        actuator's raw feed (it computes interval ratios itself, so the
+        ``stats()`` qps/latency windows stay untouched)."""
+        with self._lock:
+            return self._pad_rows, self._exec_rows
 
     def stats(self, reset_window: bool = True) -> dict:
         """Aggregate serve telemetry; also refreshes the ``Serve/qps``

@@ -148,7 +148,11 @@ class ServeEngine:
         from dptpu.models import create_model
 
         self.arch = arch
-        self.buckets = parse_buckets(buckets, source="buckets")
+        # immutable tuple, republished whole by add_bucket (one
+        # GIL-atomic store, every named exec size compiled first) from
+        # the single thread that ticks the serve-ladder actuator; all
+        # other readers take lock-free snapshots
+        self.buckets = parse_buckets(buckets, source="buckets")  # owned-by: tick-thread
         self.num_classes = num_classes
         self.image_size = image_size
         self.compute_dtype = compute_dtype
@@ -331,6 +335,44 @@ class ServeEngine:
     @property
     def max_bucket(self) -> int:
         return self.buckets[-1]
+
+    def add_bucket(self, bucket: int) -> Optional[int]:
+        """Insert an INTERIOR bucket into the ladder at runtime (the
+        tune controller's serve-ladder actuator, ISSUE 19): AOT-compile
+        the new exec size for every resident precision FIRST, then
+        publish the new ladder — no request ever hits a compile stall,
+        and admission (``max_bucket``) never moves. Returns the bucket,
+        or None when it already exists or falls outside
+        ``(0, max_bucket)`` — the actuator reads None as "no headroom"
+        and disarms cleanly."""
+        bucket = int(bucket)
+        if bucket < 1 or bucket >= self.max_bucket \
+                or bucket in self.buckets:
+            return None
+        nexec = self.exec_batch(bucket)
+        with self._lock:
+            by_precision = {
+                self._precision[g]: self._weights[g]
+                for g in sorted(self._weights)
+            }
+        for precision, placed in by_precision.items():
+            if (precision, nexec) in self._compiled:
+                continue
+            var_structs = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                placed,
+            )
+            with obs.get_tracer().span("serve_compile"):
+                exe = self._compile_at(nexec, var_structs, precision)
+            self._compiled[(precision, nexec)] = exe
+        # one GIL-atomic tuple store publishes the grown ladder to the
+        # dispatch thread's bucket_for/max_bucket reads; every exec size
+        # it names is compiled above, before the store
+        self.buckets = tuple(sorted(self.buckets + (bucket,)))
+        if self._verbose:
+            print(f"=> serve: ladder grew to {self.buckets} "
+                  f"(tune controller inserted bucket {bucket})")
+        return bucket
 
     # -- weight generations ---------------------------------------------
 

@@ -251,6 +251,19 @@ def _opt_knobs(cfg: Config) -> tuple:
 
 def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
     """Train (or evaluate) per the config; returns a result dict."""
+    # self-tuning control plane (ISSUE 19): the offline artifact applies
+    # FIRST — it env-injects ONLY knobs nothing else set, so every
+    # fail-fast parse below sees the tuned values while explicit
+    # env/CLI knobs always win; the banner names every applied value
+    from dptpu.tune.artifact import apply_tuning, tune_knobs
+
+    tune_conf = tune_knobs()
+    tuning = None
+    if tune_conf["artifact"]:
+        cli_set = set()
+        if cfg.accum_steps != 1:
+            cli_set.add("DPTPU_ACCUM")  # explicit --accum-steps wins
+        tuning = apply_tuning(tune_conf["artifact"], cli_set=cli_set)
     # resilience knobs fail fast, before any compile (the locked contract)
     if cfg.ckpt_steps < 0:
         raise ValueError(
@@ -321,15 +334,6 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                 f"DPTPU_BATCH_RAMP names epoch {batch_ramp[-1][0]} but "
                 f"the run ends at --epochs {cfg.epochs} — that phase "
                 f"would never train"
-            )
-        if el_conf["straggler_factor"] is not None:
-            # the ramp swaps the loader (and its worker pool) at phase
-            # boundaries; the controller's per-worker estimators would
-            # silently describe a dead pool — fail fast naming both
-            raise ValueError(
-                "DPTPU_STRAGGLER_FACTOR does not compose with "
-                "DPTPU_BATCH_RAMP (phase switches rebuild the worker "
-                "pool under the controller); unset one of the two"
             )
     initialize_distributed(cfg)
     derived = derive(
@@ -1656,15 +1660,63 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
                   "(set DPTPU_WORKERS_MODE=process to get a worker "
                   "pool the controller can re-split/evict)")
 
+    # online tune control (dptpu/tune/controller.py, ISSUE 19): armed
+    # by DPTPU_TUNE_CONTROL, each actuator bounded, rate-limited, and
+    # individually disarmable. No new thread: they tick on the host
+    # thread in the same post-step hook as the straggler controller.
+    tune_ctl = None
+    if tune_conf["control"] and not cfg.evaluate:
+        from dptpu.tune.controller import (
+            Controller,
+            decode_ahead_actuator,
+            host_lost_actuator,
+        )
+
+        _tune_evt = (trace_sink.log_event if trace_sink is not None
+                     else None)
+        tune_ctl = Controller()
+        if "host_lost" in tune_conf["control"] and qs is not None \
+                and derived.is_chief:
+            # chief-only, like the manual missing_hosts verdict it
+            # automates: one declaration, then the elastic restart
+            tune_ctl.add(host_lost_actuator(
+                qs.coord, lambda missing: _host_lost(),
+                deadline_s=el_conf["quorum_deadline_s"],
+                interval_s=tune_conf["interval_s"], on_event=_tune_evt,
+            ))
+        if "decode_ahead" in tune_conf["control"]:
+            if workers_mode == "process":
+                # callable indirection: the ramp phase switch rebuilds
+                # the loader and the actuator must follow it, not a
+                # closed one
+                tune_ctl.add(decode_ahead_actuator(
+                    lambda: train_loader,
+                    interval_s=tune_conf["interval_s"],
+                    on_event=_tune_evt,
+                ))
+            elif verbose:
+                print("=> tune control: decode_ahead ignored on a "
+                      "thread-mode feed (no ring to deepen)")
+        if not tune_ctl.actuators:
+            tune_ctl = None
+        elif verbose:
+            print(
+                f"=> tune control armed: "
+                f"{', '.join(a.name for a in tune_ctl.actuators)} "
+                f"(interval {tune_conf['interval_s']:g}s; disarm with "
+                f"DPTPU_TUNE_CONTROL=off)"
+            )
+
     # per-step tick: the profiling trigger, fault injection, the quorum
-    # protocol and the straggler controller all ride ONE post-step hook
-    # (order matters: faults fire before quorum reads the guard, so a
-    # same-step signal reaches agreement on the step it landed)
+    # protocol and the straggler/tune controllers all ride ONE post-step
+    # hook (order matters: faults fire before quorum reads the guard, so
+    # a same-step signal reaches agreement on the step it landed)
     _ticks = [t for t in (
         trigger.tick if trigger is not None else None,
         fault_plan.on_step if fault_plan is not None else None,
         qs.tick if qs is not None else None,
         straggler.tick if straggler is not None else None,
+        tune_ctl.tick if tune_ctl is not None else None,
     ) if t is not None]
     if not _ticks:
         obs_tick = None
@@ -1714,9 +1766,17 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         nonlocal train_loader, train_step, schedule, steps_per_epoch
         nonlocal ramp_mult
         old_batch = host_batch * ramp_mult
+        old_ahead = train_loader.decode_ahead
         ramp_mult = m
         train_loader.close()
         train_loader = _make_train_loader(host_batch * m)
+        if old_ahead is not None and (
+                train_loader.decode_ahead is None
+                or train_loader.decode_ahead < old_ahead):
+            # a controller-deepened issue window survives the rebuild
+            # (the ctor already re-applied any explicit env value; only
+            # carry forward what grew beyond it)
+            train_loader.decode_ahead = old_ahead
         steps_per_epoch = max(len(train_loader), 1)
         schedule = _phase_schedule(m, epoch)
         train_step = _build_train_step(schedule)
@@ -1724,6 +1784,10 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         manager.batch_size = host_batch * m
         if fault_plan is not None:
             fault_plan.bind_worker_kill(train_loader.kill_one_worker)
+        if straggler is not None:
+            # fresh estimator windows over the REBUILT pool — a stale
+            # verdict must never convict a fresh worker
+            straggler.rebind(train_loader)
         ramp_record.append({
             "epoch": epoch, "mult": m,
             "global_batch": run_geom[1] * m,
@@ -2163,4 +2227,8 @@ def fit(cfg: Config, *, image_size: int = 224, verbose: Optional[bool] = None):
         result["quorum"] = qs.stats()
     if straggler is not None:
         result["straggler"] = straggler.stats()
+    if tuning is not None:
+        result["tuning"] = tuning
+    if tune_ctl is not None:
+        result["tune_control"] = tune_ctl.stats()
     return result

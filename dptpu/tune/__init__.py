@@ -1,0 +1,42 @@
+"""Self-tuning control plane (ISSUE 19).
+
+Two halves close ROADMAP open item 5 — "encode the hand-tuning":
+
+* **offline** — ``dptpu tune`` searches the knob space against the
+  RACEBENCH simulated-pod cost model (``costmodel.py``, extracted from
+  scripts/run_racebench.py) plus short measured probes through the real
+  ``fit()``/``ServeEngine`` paths, and seals the winning knobs into a
+  provenance-stamped ``TUNING.json`` (``artifact.py``) that fit/serve
+  load via ``DPTPU_TUNE_ARTIFACT`` — explicit env/CLI knobs always win;
+* **online** — ``controller.py`` generalizes the PR-11 straggler
+  controller idiom (streaming estimators, persistence, probation) into
+  bounded, rate-limited, individually-disarmable actuators that ride
+  fit's post-step hook and the serve batcher's telemetry.
+
+Everything here is lazy-importing and stdlib/numpy on the hot paths:
+knob parsing and artifact loading must never drag JAX into a CLI that
+only wants to validate a file.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "apply_tuning",
+    "load_tuning",
+    "save_tuning",
+    "simulate_pod",
+    "tune_knobs",
+]
+
+
+def __getattr__(name):
+    if name in ("apply_tuning", "load_tuning", "save_tuning",
+                "tune_knobs"):
+        from dptpu.tune import artifact
+
+        return getattr(artifact, name)
+    if name == "simulate_pod":
+        from dptpu.tune.costmodel import simulate_pod
+
+        return simulate_pod
+    raise AttributeError(name)

@@ -1,0 +1,243 @@
+"""TUNING.json — the committed, sealed output of ``dptpu tune``.
+
+An artifact is a plain-JSON record (committed, diffable, precommit-
+validated) of the knob values the offline search picked on a given
+host, sealed with the same never-silent discipline as the quantization
+calibration artifact (dptpu/serve/quant.py): a CRC over the canonical
+payload so bit rot or a hand-edit fails the load by name, a
+``host`` provenance stamp so a future reader can tell which hardware
+produced the numbers, and the objective scores the winner beat.
+
+Precedence (the ISSUE 19 contract, locked in tests/test_tune.py):
+**explicit knobs always win.** ``apply_tuning`` env-injects a tuned
+value ONLY when its env twin is unset/empty and its CLI twin was not
+explicitly given (callers pass the names their CLI already bound);
+every applied value and every explicit override is named in one loud
+banner — a run never silently trains under tuned knobs.
+
+Stdlib-only: fit()/serve() load the artifact pre-jax, and the
+precommit hook validates it with no heavyweight imports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+from dptpu.envknob import env_float, env_str
+
+TUNING_SCHEMA = "dptpu-tuning-v1"
+
+# the knob space `dptpu tune` searches — an artifact may carry any
+# subset of these; anything else fails the load (a registry drift or a
+# hand-edit, either way not a tuner output)
+TUNABLE_KNOBS = (
+    "DPTPU_BUCKET_MB",
+    "DPTPU_RING_DEPTH",
+    "DPTPU_DECODE_AHEAD",
+    "DPTPU_CACHE_SCOPE",
+    "DPTPU_CACHE_BYTES",
+    "DPTPU_SERVE_BUCKETS",
+    "DPTPU_ACCUM",
+)
+
+DEFAULT_TUNE_INTERVAL_S = 10.0
+ACTUATOR_NAMES = ("host_lost", "decode_ahead", "serve_ladder")
+
+
+class TuningError(ValueError):
+    """A tuning artifact that cannot be trusted — every message names
+    the re-tune command."""
+
+
+def _retune_cmd(path: str) -> str:
+    return f"dptpu tune --out {path}"
+
+
+def _payload_crc(payload: dict) -> str:
+    canon = json.dumps(payload, sort_keys=True,
+                       separators=(",", ":")).encode()
+    return f"{zlib.crc32(canon) & 0xFFFFFFFF:08x}"
+
+
+def save_tuning(path: str, knobs: dict, objective: dict,
+                probes: dict, host: dict) -> dict:
+    """Seal + write a tuning artifact; returns the full record."""
+    bad = sorted(k for k in knobs if k not in TUNABLE_KNOBS)
+    if bad:
+        raise TuningError(
+            f"tuning artifact refuses non-tunable knob(s) "
+            f"{', '.join(bad)} — the searchable space is "
+            f"{', '.join(TUNABLE_KNOBS)}"
+        )
+    payload = {
+        "schema": TUNING_SCHEMA,
+        "knobs": {k: str(v) for k, v in sorted(knobs.items())},
+        "objective": objective,
+        "probes": probes,
+        "host": host,
+    }
+    record = dict(payload)
+    record["crc32"] = _payload_crc(payload)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return record
+
+
+def load_tuning(path: str) -> dict:
+    """Load + verify a tuning artifact; every failure is a
+    :class:`TuningError` naming the re-tune command.
+
+    Checks, in order: file present and parseable → schema known → CRC
+    seal present AND matching the canonical payload → every knob name
+    tunable with a string value."""
+    cmd = _retune_cmd(path)
+    if not os.path.exists(path):
+        raise TuningError(
+            f"tuning artifact {path} does not exist — run: {cmd}"
+        )
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except Exception as e:
+        raise TuningError(
+            f"tuning artifact {path} is not JSON ({e}) — re-tune "
+            f"with: {cmd}"
+        ) from e
+    if not isinstance(record, dict) \
+            or record.get("schema") != TUNING_SCHEMA:
+        raise TuningError(
+            f"tuning artifact {path}: schema "
+            f"{record.get('schema') if isinstance(record, dict) else None!r}"
+            f" != {TUNING_SCHEMA!r} — not a dptpu tune output (or from "
+            f"an incompatible version); re-tune with: {cmd}"
+        )
+    crc = record.get("crc32")
+    payload = {k: v for k, v in record.items() if k != "crc32"}
+    if not crc:
+        raise TuningError(
+            f"tuning artifact {path} has no crc32 seal — truncated or "
+            f"hand-built; re-tune with: {cmd}"
+        )
+    want = _payload_crc(payload)
+    if crc != want:
+        raise TuningError(
+            f"tuning artifact {path} fails its CRC seal (stamped {crc}, "
+            f"payload {want}) — bit rot or a hand-edit; re-tune with: "
+            f"{cmd}"
+        )
+    knobs = record.get("knobs")
+    if not isinstance(knobs, dict) or not knobs:
+        raise TuningError(
+            f"tuning artifact {path} carries no tuned knobs — re-tune "
+            f"with: {cmd}"
+        )
+    for k, v in knobs.items():
+        if k not in TUNABLE_KNOBS:
+            raise TuningError(
+                f"tuning artifact {path} names {k}, which is not in "
+                f"the tunable set ({', '.join(TUNABLE_KNOBS)}) — "
+                f"artifact/registry drift; re-tune with: {cmd}"
+            )
+        if not isinstance(v, str):
+            raise TuningError(
+                f"tuning artifact {path}: {k}={v!r} must be a string "
+                f"(env-injection value); re-tune with: {cmd}"
+            )
+    return record
+
+
+def apply_tuning(path: str, *, cli_set=(), environ=None,
+                 log=print) -> dict:
+    """Env-inject the artifact's knobs under the explicit-wins rule.
+
+    ``cli_set`` is the set of knob names whose CLI twin the caller saw
+    explicitly (e.g. ``--accum-steps`` → ``DPTPU_ACCUM``); those and
+    any knob whose env twin is already set are SKIPPED — the tuned
+    value never beats an operator's hand. Returns
+    ``{"applied": {...}, "overridden": {...}}`` and prints ONE banner
+    naming every decision (never a silent knob change)."""
+    env = environ if environ is not None else os.environ
+    record = load_tuning(path)
+    applied, overridden = {}, {}
+    cli_set = set(cli_set)
+    for name, value in sorted(record["knobs"].items()):
+        if env.get(name):
+            overridden[name] = f"env {name}={env[name]}"
+        elif name in cli_set:
+            overridden[name] = "explicit CLI flag"
+        else:
+            env[name] = value
+            applied[name] = value
+    host = record.get("host") or {}
+    lines = [f"TUNING: artifact {path} "
+             f"(tuned on {host.get('platform', 'unknown host')}, "
+             f"crc {record['crc32']})"]
+    for k, v in applied.items():
+        lines.append(f"TUNING:   applied {k}={v}")
+    for k, why in overridden.items():
+        lines.append(f"TUNING:   kept explicit {k} ({why})")
+    if log is not None:
+        log("\n".join(lines))
+    return {"applied": applied, "overridden": overridden,
+            "artifact": path, "crc32": record["crc32"]}
+
+
+def tune_knobs(environ=None) -> dict:
+    """The ``DPTPU_TUNE_*`` env knobs, under the locked fail-fast
+    contract:
+
+    * ``DPTPU_TUNE_ARTIFACT`` — path to a ``dptpu tune`` output;
+      fit()/serve() load + apply it (explicit knobs win). Empty =
+      no artifact (the default);
+    * ``DPTPU_TUNE_CONTROL`` — arm the online controllers: ``all``,
+      ``off`` (default), or a comma list from
+      ``host_lost``/``decode_ahead``/``serve_ladder`` — each actuator
+      individually disarmable;
+    * ``DPTPU_TUNE_INTERVAL_S`` — minimum seconds between any two
+      actuations of one controller (> 0, default 10): the rate limit
+      that keeps the loop from oscillating faster than its telemetry
+      settles.
+    """
+    raw_art = env_str("DPTPU_TUNE_ARTIFACT", "", environ)
+    raw_ctl = env_str("DPTPU_TUNE_CONTROL", "", environ).strip()
+    if not raw_ctl or raw_ctl == "off":
+        control = ()
+    elif raw_ctl == "all":
+        control = ACTUATOR_NAMES
+    else:
+        names = tuple(p.strip() for p in raw_ctl.split(",") if p.strip())
+        bad = sorted(set(names) - set(ACTUATOR_NAMES))
+        if bad:
+            raise ValueError(
+                f"DPTPU_TUNE_CONTROL={raw_ctl!r} names unknown "
+                f"actuator(s) {', '.join(bad)} — pick from "
+                f"{', '.join(ACTUATOR_NAMES)}, or 'all'/'off'"
+            )
+        control = names
+    interval = env_float("DPTPU_TUNE_INTERVAL_S",
+                         DEFAULT_TUNE_INTERVAL_S, environ)
+    if interval <= 0:
+        raise ValueError(
+            f"DPTPU_TUNE_INTERVAL_S={interval} must be > 0 seconds "
+            f"(the per-controller actuation rate limit)"
+        )
+    return {
+        "artifact": raw_art,
+        "control": control,
+        "interval_s": float(interval),
+    }
+
+
+__all__ = [
+    "ACTUATOR_NAMES",
+    "TUNABLE_KNOBS",
+    "TUNING_SCHEMA",
+    "TuningError",
+    "apply_tuning",
+    "load_tuning",
+    "save_tuning",
+    "tune_knobs",
+]

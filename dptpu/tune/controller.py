@@ -1,0 +1,297 @@
+"""Online telemetry→knob controllers (ISSUE 19 tentpole, half b).
+
+The PR-11 ``StragglerController`` proved the shape of a live control
+loop this codebase will accept: streaming estimators over telemetry the
+hot path already produces, a PERSISTENCE requirement before any verdict
+(one bad interval is noise), a fresh-verdict window after every
+escalation, and actuation through existing seams that no-op safely.
+:class:`Actuator` generalizes it:
+
+* **bounded** — each actuator owns ONE monotonic adjustment direction
+  (deepen decode-ahead, densify the serve ladder, declare a host lost)
+  with an explicit action budget and a seam that returns None at its
+  bound, so the loop can tighten a knob but never wander the knob
+  space or oscillate (there is no reverse actuation to oscillate
+  with);
+* **rate-limited** — evaluation (including the telemetry read) runs at
+  most once per ``interval_s``, so a controller can ride a per-step
+  hook without turning the KV store or the batcher lock into a hot
+  path;
+* **loud** — every verdict, actuation, and disarm lands in the event
+  log and the ``on_event`` callback (fit publishes them through obs);
+* **individually disarmable** — ``DPTPU_TUNE_CONTROL`` names the armed
+  set; an actuator also disarms ITSELF the moment its seam reports no
+  headroom or its budget is spent, and a disarmed actuator never reads
+  telemetry again.
+
+Tick placement (CONCURRENCY.md): no new threads. In fit the controller
+ticks on the host thread inside the existing post-step hook (after the
+straggler tick); in serve it ticks on ``dptpu-serve-dispatch`` between
+batches, holding no lock — each actuator's seam takes its own locks in
+rank order.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Actuator:
+    """One bounded control loop: ``read()`` telemetry at most once per
+    ``interval_s``; ``persist`` consecutive over-``threshold`` verdicts
+    fire ``act(value)``; a None read freezes the verdict (no fresh
+    evidence — the straggler controller's evidence rule); a None act
+    result or an exhausted ``max_actions`` budget disarms, loudly."""
+
+    def __init__(self, name: str, read, act, threshold: float, *,
+                 persist: int = 3, interval_s: float = 10.0,
+                 max_actions: int = 1, on_event=None,
+                 clock=time.monotonic):
+        if persist < 1:
+            raise ValueError(f"{name}: persist={persist} must be >= 1")
+        if interval_s <= 0:
+            raise ValueError(
+                f"{name}: interval_s={interval_s} must be > 0"
+            )
+        if max_actions < 1:
+            raise ValueError(
+                f"{name}: max_actions={max_actions} must be >= 1"
+            )
+        self.name = name
+        self.read = read
+        self.act = act
+        self.threshold = float(threshold)
+        self.persist = int(persist)
+        self.interval_s = float(interval_s)
+        self.max_actions = int(max_actions)
+        self.on_event = on_event
+        self.clock = clock
+        # all mutable verdict state below is owned-by: tick-thread — exactly
+        # one thread ever ticks a given actuator (the train loop in fit,
+        # dptpu-serve-dispatch in serve; CONCURRENCY.md controller-tick
+        # table), so no lock: the single-writer StragglerController argument
+        self.armed = True
+        self.disarm_reason = None
+        self.actions = 0
+        self.last_value = None
+        self.events = []
+        self._strikes = 0
+        self._last_eval = None
+
+    def _emit(self, kind: str, payload: dict):
+        evt = {"kind": kind, "actuator": self.name, **payload}
+        self.events.append(evt)
+        if self.on_event is not None:
+            try:
+                self.on_event(kind, evt)
+            except Exception:
+                pass
+
+    def disarm(self, reason: str):
+        if self.armed:
+            self.armed = False
+            self.disarm_reason = reason
+            self._emit("tune_disarm", {"reason": reason})
+
+    def tick(self):
+        """Returns the actuation payload when this tick actuated, else
+        None. Never raises: a failing read or seam disarms loudly
+        instead of taking the train/serve loop down with it."""
+        if not self.armed:
+            return None
+        now = self.clock()
+        if self._last_eval is not None \
+                and now - self._last_eval < self.interval_s:
+            return None
+        self._last_eval = now
+        try:
+            value = self.read()
+        except Exception as e:
+            self.disarm(f"telemetry read failed: {e}")
+            return None
+        if value is None:
+            return None  # no fresh evidence: the verdict freezes
+        self.last_value = value
+        if value <= self.threshold:
+            self._strikes = 0
+            return None
+        self._strikes += 1
+        self._emit("tune_verdict", {
+            "value": round(float(value), 6),
+            "threshold": self.threshold,
+            "strikes": self._strikes,
+        })
+        if self._strikes < self.persist:
+            return None
+        self._strikes = 0  # fresh verdict window after every actuation
+        try:
+            result = self.act(value)
+        except Exception as e:
+            self.disarm(f"actuation failed: {e}")
+            return None
+        if result is None:
+            self.disarm("no headroom at the seam")
+            return None
+        self.actions += 1
+        self._emit("tune_actuate", {
+            "value": round(float(value), 6), "result": result,
+            "actions": self.actions,
+        })
+        if self.actions >= self.max_actions:
+            self.disarm("action budget spent")
+        return result
+
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "armed": self.armed,
+            "disarm_reason": self.disarm_reason,
+            "actions": self.actions,
+            "last_value": self.last_value,
+            "events": list(self.events),
+        }
+
+
+class Controller:
+    """A named set of actuators sharing one tick source."""
+
+    def __init__(self, actuators=()):
+        self.actuators = list(actuators)
+
+    def add(self, actuator: Actuator):
+        self.actuators.append(actuator)
+
+    def tick(self):
+        for a in self.actuators:
+            a.tick()
+
+    def stats(self) -> dict:
+        return {a.name: a.stats() for a in self.actuators}
+
+
+# -- the three ISSUE 19 actuators, built on existing seams ---------------
+
+
+def host_lost_actuator(coord, on_lost, *, deadline_s: float,
+                       interval_s: float = 10.0, persist: int = 2,
+                       on_event=None, clock=time.monotonic) -> Actuator:
+    """Auto-arm the heartbeat-driven host-lost verdict (PR 11 follow-on
+    (b)): poll ``QuorumCoordinator.missing_hosts`` — fed by every
+    host's dedicated beat thread — and once hosts stay silent past the
+    deadline for ``persist`` evaluations, fire ``on_lost(missing)``
+    exactly once (fit's ``_host_lost``: finish the epoch, sync save,
+    exit for the elastic restart). One action, then disarmed: declaring
+    the pod smaller twice has no meaning."""
+
+    def read():
+        return float(len(coord.missing_hosts(deadline_s)))
+
+    def act(_value):
+        missing = coord.missing_hosts(deadline_s)
+        if not missing:
+            return None  # the host came back between verdict and act
+        on_lost(missing)
+        return {"missing_hosts": list(missing)}
+
+    return Actuator("host_lost", read, act, threshold=0.0,
+                    persist=persist, interval_s=interval_s,
+                    max_actions=1, on_event=on_event, clock=clock)
+
+
+def decode_ahead_actuator(loader, *, interval_s: float = 10.0,
+                          persist: int = 3, io_fraction: float = 0.25,
+                          max_ahead: int = 16, max_actions: int = 4,
+                          on_event=None,
+                          clock=time.monotonic) -> Actuator:
+    """Deepen the feed's issue window while the parent spends more than
+    ``io_fraction`` of its wall time blocked on spans: reads the
+    CUMULATIVE ring io-wait (never the obs interval — that belongs to
+    feed_stats), differentiates it over its own evaluation interval,
+    and steps ``DataLoader.grow_decode_ahead`` — one batch per
+    actuation, capped by the ring and ``max_ahead``, effective at the
+    next epoch's pipeline build. Monotonic: the window only deepens, so
+    the loop cannot oscillate; the seam's None (bound reached / thread
+    mode) disarms it.
+
+    ``loader`` may be a zero-arg callable returning the CURRENT loader:
+    the DPTPU_BATCH_RAMP phase switch rebuilds the pool, and the
+    actuator must follow the rebuild rather than keep a handle to a
+    closed loader. A rebuild resets the cumulative counter, which shows
+    up here as a negative interval — below any threshold, so the strike
+    window naturally re-baselines."""
+
+    get = loader if callable(loader) else (lambda: loader)
+    state = {"wait": None, "t": None}
+
+    def read():
+        wait, t = get().io_wait_total_s(), clock()
+        prev_wait, prev_t = state["wait"], state["t"]
+        state["wait"], state["t"] = wait, t
+        if prev_t is None or t <= prev_t:
+            return None  # first evaluation: baseline only
+        return (wait - prev_wait) / (t - prev_t)
+
+    def act(_value):
+        new = get().grow_decode_ahead(max_ahead=max_ahead)
+        if new is None:
+            return None
+        return {"decode_ahead": new}
+
+    return Actuator("decode_ahead", read, act, threshold=io_fraction,
+                    persist=persist, interval_s=interval_s,
+                    max_actions=max_actions, on_event=on_event,
+                    clock=clock)
+
+
+def serve_ladder_actuator(engine, batcher, *, interval_s: float = 10.0,
+                          persist: int = 3, waste: float = 0.25,
+                          max_actions: int = 4, on_event=None,
+                          clock=time.monotonic) -> Actuator:
+    """Densify the serve bucket ladder under sustained padding waste:
+    reads the batcher's cumulative pad/exec row counters (interval
+    ratio over its own evaluation window), and inserts the midpoint of
+    the ladder's widest multiplicative gap via
+    ``ServeEngine.add_bucket`` — compiled before publication, never
+    past ``max_bucket`` (admission never moves). Monotonic densify-only
+    with a hard action budget; a gapless ladder disarms it."""
+
+    state = {"pad": None, "exec": None}
+
+    def read():
+        pad, ex = batcher.padding_counts()
+        prev_pad, prev_ex = state["pad"], state["exec"]
+        state["pad"], state["exec"] = pad, ex
+        if prev_ex is None or ex <= prev_ex:
+            return None  # no batches this interval: verdict freezes
+        return (pad - prev_pad) / (ex - prev_ex)
+
+    def act(_value):
+        ladder = engine.buckets
+        best, best_ratio = None, 1.0
+        for lo, hi in zip(ladder, ladder[1:]):
+            mid = (lo + hi) // 2
+            if mid <= lo or mid >= hi:
+                continue
+            ratio = hi / lo
+            if ratio > best_ratio:
+                best, best_ratio = mid, ratio
+        if best is None:
+            return None  # gapless ladder: nothing left to densify
+        added = engine.add_bucket(best)
+        if added is None:
+            return None
+        return {"bucket": added, "ladder": list(engine.buckets)}
+
+    return Actuator("serve_ladder", read, act, threshold=waste,
+                    persist=persist, interval_s=interval_s,
+                    max_actions=max_actions, on_event=on_event,
+                    clock=clock)
+
+
+__all__ = [
+    "Actuator",
+    "Controller",
+    "decode_ahead_actuator",
+    "host_lost_actuator",
+    "serve_ladder_actuator",
+]

@@ -1,0 +1,109 @@
+"""The RACEBENCH simulated-pod wall-clock model, as a library.
+
+Extracted verbatim from scripts/run_racebench.py (ISSUE 19) so the
+offline autotuner can score bucket-size candidates against the SAME
+model the committed RACEBENCH.json rows came from — and so a tier-1
+test can lock the extraction as behavior-preserving by recomputing the
+committed rows (tests/test_tune_costmodel.py). run_racebench.py now
+imports from here; the bench's numbers and gates are unchanged.
+
+Pure stdlib: callers bring their own bucket-size lists (the jax-side
+``partition_buckets``/``bucket_sizes_bytes`` stay in
+dptpu/parallel/overlap.py — this module must be importable by the CLI
+pre-jax).
+"""
+
+from __future__ import annotations
+
+
+def simulate_pod(bucket_bytes_list, compute_s, dcn_gbps, latency_s,
+                 slices, inner):
+    """The wall-clock model for ONE partition of the gradients.
+
+    ``bucket_bytes_list`` is in ISSUE order (bucket 0 = last layers =
+    first gradients backward produces). Returns serial/overlapped wall
+    seconds plus the per-bucket event trace."""
+    total = sum(bucket_bytes_list) or 1
+    bw = dcn_gbps * 1e9
+    ring = 2.0 * (slices - 1) / slices
+
+    def comm_s(nbytes):
+        return latency_s + ring * (nbytes / inner) / bw
+
+    # backward produces bucket k's gradients after its proportional
+    # compute segment (recorded assumption: FLOPs track bytes)
+    ready, acc = [], 0.0
+    for b in bucket_bytes_list:
+        acc += compute_s * (b / total)
+        ready.append(acc)
+    # overlapped: FIFO DCN channel, a bucket issues when ready
+    t_chan = 0.0
+    events = []
+    for b, r in zip(bucket_bytes_list, ready):
+        start = max(r, t_chan)
+        t_chan = start + comm_s(b)
+        events.append({"bytes": b, "grads_ready_s": round(r, 6),
+                       "comm_start_s": round(start, 6),
+                       "comm_end_s": round(t_chan, 6)})
+    overlapped = max(compute_s, t_chan)
+    serial = compute_s + sum(comm_s(b) for b in bucket_bytes_list)
+    return {"serial_s": serial, "overlapped_s": overlapped,
+            "exposed_comm_s": max(0.0, overlapped - compute_s),
+            "events": events}
+
+
+def model_row(anchor, t_compute, bucket_mb, sizes, perleaf_sizes,
+              dcn_gbps, latency_s, slices, inner):
+    """One RACEBENCH ``simulated_pod`` row: the overlapped/serial/
+    per-leaf walls for one (compute anchor, bucket size, bandwidth)
+    point, with the rounding the committed artifact carries."""
+    sim = simulate_pod(sizes, t_compute, dcn_gbps, latency_s,
+                       slices, inner)
+    perleaf = simulate_pod(perleaf_sizes, t_compute, dcn_gbps,
+                           latency_s, slices, inner)
+    comm_s = sim["serial_s"] - t_compute
+    return {
+        "compute_anchor": anchor,
+        "compute_ms": round(t_compute * 1e3, 3),
+        "bucket_mb": bucket_mb,
+        "buckets": len(sizes),
+        "dcn_gbps": dcn_gbps,
+        "serial_ms": round(sim["serial_s"] * 1e3, 3),
+        "overlapped_ms": round(sim["overlapped_s"] * 1e3, 3),
+        "exposed_comm_ms": round(sim["exposed_comm_s"] * 1e3, 3),
+        # the REAL overlap statement: what fraction of the
+        # communication disappears under backward (a lost win shows
+        # here even though overlapped < serial holds trivially for any
+        # >= 2-bucket partition)
+        "hidden_comm_fraction": round(
+            1.0 - sim["exposed_comm_s"] / max(comm_s, 1e-12), 4),
+        "speedup": round(
+            sim["serial_s"] / max(sim["overlapped_s"], 1e-12), 3),
+        "perleaf_serial_ms": round(perleaf["serial_s"] * 1e3, 3),
+        "perleaf_overlapped_ms": round(perleaf["overlapped_s"] * 1e3, 3),
+    }
+
+
+def greedy_bucket_sizes(leaf_bytes, bucket_bytes):
+    """The engine's greedy partition over a leaf-byte list, payload
+    bytes only (dptpu/parallel/overlap.py ``partition_buckets`` without
+    the pytree or the dtype splits): a bucket closes when adding the
+    next leaf would exceed ``bucket_bytes`` (an over-sized leaf still
+    gets its own bucket). ``leaf_bytes`` must already be in issue order
+    (reverse flatten order). Lets the tuner sweep candidate bucket
+    sizes from a recorded leaf-byte profile without building params."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes={bucket_bytes} must be > 0")
+    sizes, acc = [], 0
+    for b in leaf_bytes:
+        nb = int(b)
+        if acc and acc + nb > bucket_bytes:
+            sizes.append(acc)
+            acc = 0
+        acc += nb
+    if acc:
+        sizes.append(acc)
+    return sizes or [0]
+
+
+__all__ = ["greedy_bucket_sizes", "model_row", "simulate_pod"]

@@ -1,0 +1,316 @@
+"""Offline knob search for ``dptpu tune`` (ISSUE 19 tentpole, half a).
+
+Two kinds of evidence, deliberately separated:
+
+* **Analytic** — the RACEBENCH simulated-pod cost model
+  (:mod:`dptpu.tune.costmodel`) scores every ``DPTPU_BUCKET_MB``
+  candidate for a given arch/geometry/DCN in microseconds of arithmetic,
+  and a padding-waste model scores serve bucket ladders against a
+  request-size mix. Cheap enough to sweep the whole candidate grid.
+* **Measured** — short REAL runs: ``fit()`` on synthetic data probes
+  the host-feed knobs the model cannot see (decode-ahead, ring depth,
+  cache scope, accumulation), and a real ``ServeEngine`` +
+  ``DynamicBatcher`` pass can re-check the chosen ladder end to end.
+  Probes are paired against the default (the candidate must BEAT the
+  measured default plus the host's own noise floor, or the knob is left
+  alone) — a tuner that emits knobs it cannot defend is worse than no
+  tuner.
+
+The search never writes env: every probe saves/restores the knobs it
+touches, and the output is a plain dict for
+:func:`dptpu.tune.artifact.save_tuning` to seal.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+# DPTPU_BUCKET_MB candidates: geometric sweep around the shipped 25 MB
+# default — small enough to amortize latency, large enough to overlap
+CANDIDATE_BUCKET_MB = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 25.0)
+
+# serve ladder candidates, all within the default admission bound of 64
+CANDIDATE_LADDERS = (
+    (1, 4, 16, 64),            # shipped default
+    (1, 2, 4, 8, 16, 32, 64),  # dense powers of two
+    (1, 4, 8, 16, 32, 64),
+    (1, 8, 64),                # sparse (wins only on bimodal mixes)
+)
+
+
+def model_leaf_sizes(arch: str, image_size: int = 224,
+                     num_classes: int = 1000):
+    """Per-leaf gradient bytes in REVERSE flatten order — the overlap
+    engine's issue order — via ``jax.eval_shape`` (no real init, no
+    device memory: shapes only)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dptpu.models import create_model
+
+    model = create_model(arch, num_classes=num_classes)
+    variables = jax.eval_shape(
+        lambda rng: model.init(
+            rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
+            train=False,
+        ),
+        jax.random.PRNGKey(0),
+    )
+    leaves = jax.tree_util.tree_leaves(variables["params"])
+    sizes = [int(_prod(l.shape)) * 4 if l.shape else 4 for l in leaves]
+    return list(reversed(sizes))
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def search_bucket_mb(perleaf_sizes, compute_s: float, *,
+                     dcn_gbps: float, latency_s: float, slices: int,
+                     inner: int, candidates=CANDIDATE_BUCKET_MB) -> dict:
+    """Sweep ``DPTPU_BUCKET_MB`` candidates against the simulated-pod
+    model; returns the winner (min overlapped step; ties break toward
+    the LARGER bucket — fewer collectives for the same wall clock) and
+    the full scored table for the artifact's provenance."""
+    from dptpu.tune.costmodel import greedy_bucket_sizes, model_row
+
+    rows = []
+    for mb in sorted(candidates):
+        sizes = greedy_bucket_sizes(perleaf_sizes, int(mb * 1e6))
+        rows.append(model_row(
+            "chip_equivalent", compute_s, mb, sizes, perleaf_sizes,
+            dcn_gbps, latency_s, slices, inner,
+        ))
+    best = min(rows, key=lambda r: (r["overlapped_ms"], -r["bucket_mb"]))
+    return {"best_bucket_mb": best["bucket_mb"], "best_row": best,
+            "rows": rows}
+
+
+def ladder_waste(ladder, request_sizes) -> float:
+    """Padding-waste fraction of a bucket ladder over a request mix:
+    padded rows / executed rows, each request routed to the smallest
+    bucket that holds it (``ServeEngine.bucket_for``), oversize
+    requests split greedily from the top (the batcher's chunking)."""
+    ladder = sorted(ladder)
+    pad = ex = 0
+    for n in request_sizes:
+        n = int(n)
+        while n > 0:
+            for b in ladder:
+                if b >= n:
+                    break
+            take = min(n, b)
+            pad += b - take
+            ex += b
+            n -= take
+    return pad / max(ex, 1)
+
+
+def search_serve_buckets(request_sizes, *,
+                         candidates=CANDIDATE_LADDERS) -> dict:
+    """Score candidate ladders against the expected request-size mix
+    (analytic: no compile). Denser ladders pay more AOT compiles, so
+    ties break toward FEWER buckets."""
+    rows = []
+    for ladder in candidates:
+        rows.append({
+            "ladder": list(ladder),
+            "waste": round(ladder_waste(ladder, request_sizes), 4),
+        })
+    best = min(rows, key=lambda r: (r["waste"], len(r["ladder"])))
+    return {
+        "best_ladder": best["ladder"],
+        "best_waste": best["waste"],
+        "rows": rows,
+    }
+
+
+def default_request_mix(max_size: int = 64, seed: int = 0):
+    """The mix the analytic ladder search scores against when the
+    operator gives no trace: geometric-ish small-heavy sizes (most
+    serving traffic is singles and small bursts) plus occasional
+    near-max batches."""
+    import random
+
+    rng = random.Random(seed)
+    mix = []
+    for _ in range(512):
+        r = rng.random()
+        if r < 0.5:
+            mix.append(rng.randint(1, 4))
+        elif r < 0.85:
+            mix.append(rng.randint(5, 24))
+        else:
+            mix.append(rng.randint(25, max_size))
+    return mix
+
+
+class _env_patch:
+    """Save/restore the env knobs a probe touches — the search must
+    never leak a candidate into the caller's environment."""
+
+    def __init__(self, overrides: dict):
+        self.overrides = dict(overrides)
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self.overrides.items():
+            self._saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return False
+
+
+def probe_fit(overrides: dict, *, arch: str = "resnet18",
+              images: int = 256, batch: int = 32, epochs: int = 1,
+              image_size: int = 32, workers: int = 2,
+              seed: int = 0) -> float:
+    """One short REAL ``fit()`` on synthetic data under the candidate
+    env; returns steady-state images/sec. Checkpoints and TB runs land
+    in a scratch dir, never the repo (the obsbench discipline)."""
+    from dptpu.config import Config
+    from dptpu.train import fit
+
+    cfg = Config(
+        data=f"synthetic:{images}",
+        variant="apex",
+        arch=arch,
+        epochs=epochs,
+        batch_size=batch,
+        lr=0.05,
+        workers=workers,
+        print_freq=10_000,
+        seed=seed,
+        opt_level="O0",
+    )
+    # process-mode data workers re-import dptpu in the spawn child with
+    # the parent's sys.path; a relative '' entry stops resolving once we
+    # chdir into the scratch dir, so pin the absolute package root
+    import sys
+
+    import dptpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(dptpu.__file__)))
+    if pkg_root not in sys.path:
+        sys.path.insert(0, pkg_root)
+    cwd = os.getcwd()
+    rundir = tempfile.mkdtemp(prefix="dptpu_tune_probe_")
+    with _env_patch(overrides):
+        os.chdir(rundir)
+        try:
+            result = fit(cfg, image_size=image_size, verbose=False)
+        finally:
+            os.chdir(cwd)
+    hist = result["history"]
+    steady = hist[1:] if len(hist) > 1 else hist
+    bt = sum(h["train_batch_time"] for h in steady) / len(steady)
+    return batch / max(bt, 1e-9)
+
+
+def probe_knob_paired(knob: str, candidate: str, base_env: dict,
+                      *, reps: int = 2, log=print, **fit_kw) -> dict:
+    """Measured A/B for one knob: interleaved default/candidate pairs
+    in ABBA order (the obsbench drift-cancelling recipe), decided on
+    the MEDIAN of per-pair relative deltas. The candidate must beat
+    the default by more than the default arm's own spread — otherwise
+    the verdict is "keep the default" and no knob is emitted."""
+    from statistics import median
+
+    rates = {"default": [], "candidate": []}
+    for rep in range(reps):
+        arms = (("default", None), ("candidate", candidate))
+        if rep % 2:
+            arms = arms[::-1]
+        for arm, value in arms:
+            env = dict(base_env)
+            if value is not None:
+                env[knob] = value
+            rate = probe_fit(env, **fit_kw)
+            rates[arm].append(round(rate, 1))
+            log(f"  probe {knob}={value if value is not None else '<default>'}"
+                f" rep {rep}: {rate:.1f} img/s")
+    paired = [
+        (c - d) / d * 100.0
+        for d, c in zip(rates["default"], rates["candidate"])
+    ]
+    gain_pct = median(paired)
+    noise_pct = (max(rates["default"]) - min(rates["default"])) \
+        / max(rates["default"]) * 100.0
+    return {
+        "knob": knob,
+        "candidate": candidate,
+        "default_img_s": rates["default"],
+        "candidate_img_s": rates["candidate"],
+        "paired_deltas_pct": [round(p, 3) for p in paired],
+        "gain_pct": round(gain_pct, 3),
+        "noise_pct": round(noise_pct, 3),
+        "adopt": bool(gain_pct > max(noise_pct, 0.5)),
+    }
+
+
+def probe_serve_ladder(ladder, request_sizes, *, arch: str = "resnet18",
+                       image_size: int = 32,
+                       num_classes: int = 16) -> dict:
+    """Measured end-to-end check of a ladder through a REAL
+    ``ServeEngine`` + ``DynamicBatcher``: replay the request mix,
+    report the batcher's own padding counters. Costs one AOT compile
+    per bucket — the expensive probe, gated behind ``--serve-probe``."""
+    import numpy as np
+
+    from dptpu.serve.batcher import DynamicBatcher
+    from dptpu.serve.engine import ServeEngine
+
+    engine = ServeEngine(
+        arch, buckets=tuple(sorted(ladder)), num_classes=num_classes,
+        image_size=image_size, verbose=False,
+    )
+    batcher = DynamicBatcher(engine, max_delay_ms=0.5)
+    try:
+        rng = np.random.RandomState(0)
+        img = rng.randint(
+            0, 256, (image_size, image_size, 3)
+        ).astype(np.uint8)
+        for n in request_sizes:
+            # one burst per mix entry, drained before the next so the
+            # coalescer sees the intended batch-size distribution
+            futs = [batcher.submit_array(img) for _ in range(int(n))]
+            for f in futs:
+                f.result(timeout=300.0)
+        pad, ex = batcher.padding_counts()
+        return {
+            "ladder": list(sorted(ladder)),
+            "pad_rows": int(pad),
+            "exec_rows": int(ex),
+            "measured_waste": round(pad / max(ex, 1), 4),
+        }
+    finally:
+        batcher.close()
+
+
+__all__ = [
+    "CANDIDATE_BUCKET_MB",
+    "CANDIDATE_LADDERS",
+    "default_request_mix",
+    "ladder_waste",
+    "model_leaf_sizes",
+    "probe_fit",
+    "probe_knob_paired",
+    "probe_serve_ladder",
+    "search_bucket_mb",
+    "search_serve_buckets",
+]

@@ -21,6 +21,18 @@ cd "$(dirname "$(readlink -f "$0")")/.."
 echo "=> dptpu check --no-hlo --changed-only"
 python -m dptpu.analysis --no-hlo --changed-only
 
+# a committed TUNING.json must load clean (schema + CRC seal): a
+# hand-edit or merge-mangled artifact should fail here, not at the
+# first fit() that loads it
+if git diff --cached --name-only 2>/dev/null | grep -qx "TUNING.json"; then
+    echo "=> validate TUNING.json (schema + crc)"
+    python - <<'EOF'
+from dptpu.tune.artifact import load_tuning
+rec = load_tuning("TUNING.json")
+print(f"   ok: {len(rec['knobs'])} knobs, crc {rec['crc32']}")
+EOF
+fi
+
 if [ "${PRECOMMIT_LINT_ONLY:-0}" != "1" ]; then
     # the fast tier: unit tests with no model compiles (~1-2 min); the
     # conftest arms DPTPU_SYNC_CHECK=1 + the thread census, so the

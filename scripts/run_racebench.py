@@ -71,40 +71,11 @@ _CHILD_ENV = "DPTPU_RACEBENCH_CHILD"
 TOP1_BAR = 80.0  # the shared convergence bar (scripts/run_convergence.py)
 
 
-def simulate_pod(bucket_bytes_list, compute_s, dcn_gbps, latency_s,
-                 slices, inner):
-    """The wall-clock model for ONE partition of the gradients.
-
-    ``bucket_bytes_list`` is in ISSUE order (bucket 0 = last layers =
-    first gradients backward produces). Returns serial/overlapped wall
-    seconds plus the per-bucket event trace."""
-    total = sum(bucket_bytes_list) or 1
-    bw = dcn_gbps * 1e9
-    ring = 2.0 * (slices - 1) / slices
-
-    def comm_s(nbytes):
-        return latency_s + ring * (nbytes / inner) / bw
-
-    # backward produces bucket k's gradients after its proportional
-    # compute segment (recorded assumption: FLOPs track bytes)
-    ready, acc = [], 0.0
-    for b in bucket_bytes_list:
-        acc += compute_s * (b / total)
-        ready.append(acc)
-    # overlapped: FIFO DCN channel, a bucket issues when ready
-    t_chan = 0.0
-    events = []
-    for b, r in zip(bucket_bytes_list, ready):
-        start = max(r, t_chan)
-        t_chan = start + comm_s(b)
-        events.append({"bytes": b, "grads_ready_s": round(r, 6),
-                       "comm_start_s": round(start, 6),
-                       "comm_end_s": round(t_chan, 6)})
-    overlapped = max(compute_s, t_chan)
-    serial = compute_s + sum(comm_s(b) for b in bucket_bytes_list)
-    return {"serial_s": serial, "overlapped_s": overlapped,
-            "exposed_comm_s": max(0.0, overlapped - compute_s),
-            "events": events}
+# the wall-clock model itself lives in dptpu/tune/costmodel.py since
+# ISSUE 19 (the autotuner scores candidates against the same model);
+# tests/test_tune_costmodel.py locks the extraction against the
+# committed RACEBENCH.json rows
+from dptpu.tune.costmodel import model_row, simulate_pod  # noqa: E402,F401
 
 
 def run_minutes_recipe(args, repo_root):
@@ -408,6 +379,8 @@ def main():
     # step time from the repo's roofline-measured device rate
     # (BENCH_r04), which is the regime the race actually runs in
     t_chip = args.per_chip_batch / args.chip_img_per_s
+    perleaf_sizes = [int(np.prod(l.shape)) * 4 if l.shape else 4
+                     for l in reversed(leaves)]
     model_rows = []
     for anchor, t_compute in (("measured_host", t_step),
                               ("chip_equivalent", t_chip)):
@@ -415,38 +388,10 @@ def main():
             buckets = partition_buckets(params, int(mb * 1e6))
             sizes = bucket_sizes_bytes(params, buckets)
             for bw in args.dcn_gbps:
-                sim = simulate_pod(sizes, t_compute, bw, latency_s, S, I)
-                perleaf = simulate_pod(
-                    [int(np.prod(l.shape)) * 4 if l.shape else 4
-                     for l in reversed(leaves)],
-                    t_compute, bw, latency_s, S, I,
-                )
-                comm_s = sim["serial_s"] - t_compute
-                model_rows.append({
-                    "compute_anchor": anchor,
-                    "compute_ms": round(t_compute * 1e3, 3),
-                    "bucket_mb": mb,
-                    "buckets": len(sizes),
-                    "dcn_gbps": bw,
-                    "serial_ms": round(sim["serial_s"] * 1e3, 3),
-                    "overlapped_ms": round(sim["overlapped_s"] * 1e3, 3),
-                    "exposed_comm_ms": round(
-                        sim["exposed_comm_s"] * 1e3, 3),
-                    # the REAL overlap statement: what fraction of the
-                    # communication disappears under backward (a lost
-                    # win shows here even though overlapped < serial
-                    # holds trivially for any >= 2-bucket partition)
-                    "hidden_comm_fraction": round(
-                        1.0 - sim["exposed_comm_s"] / max(comm_s, 1e-12),
-                        4),
-                    "speedup": round(
-                        sim["serial_s"]
-                        / max(sim["overlapped_s"], 1e-12), 3),
-                    "perleaf_serial_ms": round(
-                        perleaf["serial_s"] * 1e3, 3),
-                    "perleaf_overlapped_ms": round(
-                        perleaf["overlapped_s"] * 1e3, 3),
-                })
+                model_rows.append(model_row(
+                    anchor, t_compute, mb, sizes, perleaf_sizes,
+                    bw, latency_s, S, I,
+                ))
     # headline: the chip-equivalent regime at the first bandwidth and
     # bucket size. overlapped < serial is trivially true for any
     # multi-bucket partition, so the gate binds on the hidden-comm

@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""TUNEBENCH: the self-tuning control plane's own gate → TUNEBENCH.json.
+
+The autotuner's promise is NEVER-WORSE-THAN-DEFAULT: a run that loads
+TUNING.json must not regress against the same run with the artifact
+left out. Three arms, two of them measured:
+
+1. **Cost model (analytic)** — the tuned ``DPTPU_BUCKET_MB`` scored
+   against the shipped 25 MB default on the RACEBENCH simulated-pod
+   model at the tuned geometry: tuned overlapped step <= default
+   overlapped step, deterministically.
+2. **Measured fit()** — interleaved default/tuned ``fit()`` pairs in
+   ABBA order on synthetic data, the artifact applied through the REAL
+   ``DPTPU_TUNE_ARTIFACT`` load path (so the bench also proves the
+   precedence plumbing end to end). Gate on the MEDIAN of per-pair
+   relative deltas, widened to the host's own noise floor — the
+   obsbench drift-cancelling recipe (a never-worse question cannot be
+   answered through 5% run-to-run noise).
+3. **Serve ladder (analytic)** — the artifact's ladder (or the default
+   when the tuner kept it) padding waste <= the default ladder's on
+   the tuner's request mix.
+
+``--smoke`` is the tier-1-adjacent CI preset: tunes a fresh artifact
+with ``--probe none`` (cost model + analytic ladder only) and runs
+small measured pairs. Writes TUNEBENCH.json at the repo root (or
+``--out``); exits non-zero when a gate fails.
+
+Usage: python scripts/run_tunebench.py [--smoke] [--artifact PATH]
+       [--reps N] [--images N] [--gate-pct 2.0] [--no-gate]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_BUCKET_MB = 25.0        # dptpu/parallel/overlap.py default
+DEFAULT_LADDER = [1, 4, 16, 64]  # dptpu/serve/knobs.py default
+
+
+def run_fit_arm(tuned: bool, artifact: str, *, images, batch, epochs,
+                arch, image_size):
+    """One fit() with the artifact applied through the REAL env-knob
+    path (tuned arm) or guaranteed absent (default arm); returns
+    steady-state imgs/s."""
+    from dptpu.config import Config
+    from dptpu.train import fit
+
+    saved = {k: os.environ.get(k) for k in ("DPTPU_TUNE_ARTIFACT",)}
+    # the artifact env-injects knobs on load: snapshot so the default
+    # arm (and the next pair) starts from a clean slate
+    from dptpu.tune.artifact import TUNABLE_KNOBS
+
+    saved.update({k: os.environ.get(k) for k in TUNABLE_KNOBS})
+    if tuned:
+        os.environ["DPTPU_TUNE_ARTIFACT"] = artifact
+    else:
+        os.environ.pop("DPTPU_TUNE_ARTIFACT", None)
+    cfg = Config(
+        data=f"synthetic:{images}", variant="apex", arch=arch,
+        epochs=epochs, batch_size=batch, lr=0.05, workers=2,
+        print_freq=10_000, seed=0, opt_level="O0",
+    )
+    cwd = os.getcwd()
+    rundir = tempfile.mkdtemp(prefix="dptpu_tunebench_run_")
+    os.chdir(rundir)
+    try:
+        result = fit(cfg, image_size=image_size, verbose=False)
+    finally:
+        os.chdir(cwd)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    hist = result["history"]
+    steady = hist[1:] if len(hist) > 1 else hist
+    bt = sum(h["train_batch_time"] for h in steady) / len(steady)
+    if tuned and "tuning" not in result:
+        raise RuntimeError(
+            "tuned arm ran without loading the artifact — the "
+            "DPTPU_TUNE_ARTIFACT plumbing is broken"
+        )
+    return batch / max(bt, 1e-9), result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: fresh --probe none artifact, "
+                         "small measured pairs, same gates")
+    ap.add_argument("--artifact", default=None, metavar="PATH",
+                    help="existing TUNING.json to gate (default: tune "
+                         "a fresh one into a scratch dir)")
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--images", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--reps", type=int, default=None,
+                    help="interleaved default/tuned pairs")
+    ap.add_argument("--gate-pct", type=float, default=2.0,
+                    help="max tuned-vs-default throughput loss (%%); "
+                         "widens to the host's measured noise")
+    ap.add_argument("--no-gate", action="store_true")
+    ap.add_argument("--out", default="TUNEBENCH.json")
+    args = ap.parse_args()
+    images = args.images or (256 if args.smoke else 1024)
+    epochs = args.epochs or (2 if args.smoke else 3)
+    reps = args.reps or (2 if args.smoke else 3)
+
+    t0 = time.time()
+    # -- the artifact under test --------------------------------------
+    # absolute: the measured arms run fit() from scratch dirs
+    artifact = os.path.abspath(args.artifact) if args.artifact else None
+    if artifact is None:
+        from dptpu.tune.cli import main_tune
+
+        artifact = os.path.join(
+            tempfile.mkdtemp(prefix="dptpu_tunebench_art_"),
+            "TUNING.json",
+        )
+        tune_args = ["--out", artifact, "--arch", args.arch,
+                     "--image-size", str(args.image_size)]
+        if args.smoke:
+            tune_args += ["--probe", "none"]
+        else:
+            tune_args += ["--probe", "quick",
+                          "--probe-images", str(images)]
+        main_tune(tune_args)
+    from dptpu.tune.artifact import load_tuning
+
+    record = load_tuning(artifact)
+    knobs = record["knobs"]
+    print(f"=> tunebench: gating {artifact} "
+          f"(knobs {json.dumps(knobs)})", file=sys.stderr)
+
+    # -- arm 1: cost model, tuned vs default bucket size --------------
+    from dptpu.tune.costmodel import greedy_bucket_sizes, model_row
+    from dptpu.tune.search import model_leaf_sizes
+
+    obj = record["objective"]["cost_model"]
+    perleaf = model_leaf_sizes(
+        obj["arch"], image_size=args.image_size, num_classes=16,
+    )
+    t_chip = obj["per_chip_batch"] / obj["chip_img_per_s"]
+
+    def score(mb):
+        sizes = greedy_bucket_sizes(perleaf, int(mb * 1e6))
+        return model_row(
+            "chip_equivalent", t_chip, mb, sizes, perleaf,
+            obj["dcn_gbps"], obj["dcn_latency_us"] * 1e-6,
+            obj["slices"], obj["chips_per_slice"],
+        )
+
+    tuned_mb = float(knobs.get("DPTPU_BUCKET_MB", DEFAULT_BUCKET_MB))
+    row_default = score(DEFAULT_BUCKET_MB)
+    row_tuned = score(tuned_mb)
+    model_ok = row_tuned["overlapped_ms"] <= row_default["overlapped_ms"]
+
+    # -- arm 2: measured fit(), default vs tuned (ABBA pairs) ---------
+    rates = {"default": [], "tuned": []}
+    applied_banner = None
+    for rep in range(reps):
+        arms = (("default", False), ("tuned", True))
+        if rep % 2:
+            arms = arms[::-1]
+        for arm, tuned in arms:
+            rate, result = run_fit_arm(
+                tuned, artifact, images=images, batch=args.batch,
+                epochs=epochs, arch=args.arch,
+                image_size=args.image_size,
+            )
+            rates[arm].append(round(rate, 1))
+            if tuned and applied_banner is None:
+                applied_banner = result["tuning"]
+            print(f"rep {rep} {arm}: {rate:.1f} img/s", file=sys.stderr)
+    from statistics import median
+
+    paired = [
+        (t - d) / d * 100.0
+        for d, t in zip(rates["default"], rates["tuned"])
+    ]
+    tuned_delta_pct = median(paired)  # > 0 = tuned faster
+    noise_pct = (max(rates["default"]) - min(rates["default"])) \
+        / max(rates["default"]) * 100.0
+    paired_spread_pct = (
+        max(paired) - min(paired) if len(paired) > 1 else 0.0
+    )
+    effective_gate = max(args.gate_pct, noise_pct, paired_spread_pct)
+    measured_ok = -tuned_delta_pct < effective_gate
+
+    # -- arm 3: serve ladder padding waste ----------------------------
+    from dptpu.tune.search import default_request_mix, ladder_waste
+
+    mix = default_request_mix(DEFAULT_LADDER[-1])
+    if "DPTPU_SERVE_BUCKETS" in knobs:
+        tuned_ladder = [int(b) for b in
+                        knobs["DPTPU_SERVE_BUCKETS"].split(",")]
+    else:
+        tuned_ladder = DEFAULT_LADDER
+    waste_default = ladder_waste(DEFAULT_LADDER, mix)
+    waste_tuned = ladder_waste(tuned_ladder, mix)
+    ladder_ok = waste_tuned <= waste_default
+
+    gates = {
+        "cost_model_ok": bool(model_ok),
+        "measured_ok": bool(measured_ok),
+        "ladder_ok": bool(ladder_ok),
+        "artifact_loaded_ok": bool(applied_banner is not None),
+    }
+    import jax
+
+    out = {
+        "bench": "tuned-vs-default never-worse gate "
+                 "(scripts/run_tunebench.py)",
+        "smoke": bool(args.smoke),
+        "backend": jax.default_backend(),
+        "artifact": artifact,
+        "artifact_crc32": record["crc32"],
+        "knobs": knobs,
+        "cost_model": {
+            "default_bucket_mb": DEFAULT_BUCKET_MB,
+            "tuned_bucket_mb": tuned_mb,
+            "default_overlapped_ms": row_default["overlapped_ms"],
+            "tuned_overlapped_ms": row_tuned["overlapped_ms"],
+            "default_speedup": row_default["speedup"],
+            "tuned_speedup": row_tuned["speedup"],
+        },
+        "measured": {
+            "arch": args.arch,
+            "image_size": args.image_size,
+            "images": images,
+            "batch": args.batch,
+            "epochs_per_run": epochs,
+            "reps": reps,
+            "imgs_per_sec_default": rates["default"],
+            "imgs_per_sec_tuned": rates["tuned"],
+            "paired_deltas_pct": [round(p, 3) for p in paired],
+            # median of per-pair (tuned-default)/default; > 0 = faster
+            "tuned_delta_pct": round(tuned_delta_pct, 3),
+            "default_arm_noise_pct": round(noise_pct, 3),
+            "paired_spread_pct": round(paired_spread_pct, 3),
+            "gate_pct": args.gate_pct,
+            "effective_gate_pct": round(effective_gate, 3),
+            "applied": applied_banner,
+        },
+        "serve_ladder": {
+            "default": DEFAULT_LADDER,
+            "tuned": tuned_ladder,
+            "default_waste": round(waste_default, 4),
+            "tuned_waste": round(waste_tuned, 4),
+        },
+        "gates": gates,
+        "bench_wall_s": round(time.time() - t0, 1),
+    }
+    from bench_util import host_provenance
+
+    out["host"] = host_provenance()
+    out_path = args.out if os.path.isabs(args.out) else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        args.out,
+    )
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "knobs": knobs,
+        "tuned_delta_pct": out["measured"]["tuned_delta_pct"],
+        "effective_gate_pct": out["measured"]["effective_gate_pct"],
+        "gates": gates,
+    }))
+    print(f"wrote {out_path}")
+    if not args.no_gate and not all(gates.values()):
+        print(f"TUNEBENCH gate FAILED: {gates}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,14 @@ _FAST_MODULES = {
     # the fleet tier is pure stdlib threads + loopback HTTP — the
     # zero-failed-failover acceptance bar MUST hold in tier 1
     "test_serve_quant", "test_fleet",
+    # self-tuning control plane (ISSUE 19): artifact/controller/search
+    # units are pure; the precedence locks ride tiny resnet18@32 fits
+    # (the test_fault_resume precedent) and the cost-model extraction
+    # lock is analytic — explicit-knobs-win and bounded-actuation bars
+    # MUST hold in tier 1; the tunebench smoke is the eighth fit-shaped
+    # exception (one subprocess, --smoke preset, same gates as
+    # TUNEBENCH.json)
+    "test_tune", "test_tune_costmodel", "test_tunebench_smoke",
 }
 
 

@@ -245,15 +245,21 @@ def test_fit_batch_ramp_beyond_epochs_raises(_clean_recipe_env):
         fit(cfg, image_size=32, verbose=False)
 
 
-def test_fit_batch_ramp_straggler_composition_raises(_clean_recipe_env):
+def test_fit_batch_ramp_straggler_composition_allowed(_clean_recipe_env):
+    """The ramp x straggler refusal is gone: StragglerController
+    survives the DPTPU_BATCH_RAMP pool rebuild via rebind() (semantics
+    locked in tests/test_tune.py), so fit must accept the pair and run
+    the ramp to completion."""
     from dptpu.train.fit import fit
 
     _clean_recipe_env.setenv("DPTPU_BATCH_RAMP", "1:2")
     _clean_recipe_env.setenv("DPTPU_STRAGGLER_FACTOR", "2.0")
     cfg = Config(data="synthetic:64", arch="resnet18", batch_size=16,
                  epochs=3, warmup_epochs=1)
-    with pytest.raises(ValueError, match="DPTPU_STRAGGLER_FACTOR"):
-        fit(cfg, image_size=32, verbose=False)
+    result = fit(cfg, image_size=32, verbose=False)
+    assert len(result["history"]) == 3
+    # the ramp actually fired: epoch 1+ trains the doubled batch
+    assert result["batch_ramp"][-1]["global_batch"] == 32
 
 
 def test_fit_batch_ramp_tp_composition_names_alternatives(
